@@ -1,0 +1,51 @@
+"""Test fixture: run everything on 8 virtual CPU devices.
+
+TPU translation of the reference's multi-process-without-cluster trick
+(`mpirun -np 2 pytest` on localhost CPU, reference .travis.yml:96-103):
+``--xla_force_host_platform_device_count=8`` gives one process eight XLA
+"replicas" so collective correctness runs anywhere (SURVEY.md §4).
+
+This must happen before the first JAX backend use.  The container pins
+``JAX_PLATFORMS=axon`` (single real TPU chip over a tunnel); tests force
+the CPU platform in-process so they never touch — or wait on — the chip.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+# Keep test runs off the real TPU tunnel (see memory: axon-cpu-test-env).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def hvd():
+    """Initialized horovod_tpu over all 8 virtual devices; fresh per test."""
+    import horovod_tpu as hvd
+
+    hvd.init(devices=jax.devices())
+    yield hvd
+    hvd.shutdown()
+
+
+@pytest.fixture()
+def hvd2():
+    """Initialized over a 2-device subset (matches the reference's
+    mpirun -np 2 test topology)."""
+    import horovod_tpu as hvd
+
+    hvd.init(devices=jax.devices()[:2])
+    yield hvd
+    hvd.shutdown()

@@ -1,0 +1,97 @@
+"""Allgather tests: rank-order concat, variable dim-0, mismatch errors
+(≙ reference test_tensorflow.py:307-427, test_torch.py:296-360)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+DTYPES = [jnp.uint8, jnp.int32, jnp.int64, jnp.float32]
+DIMS = [1, 2, 3]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("dim", DIMS)
+def test_allgather_equal_sizes(hvd, dtype, dim):
+    """Each replica contributes a rank-constant block; the gathered result
+    must contain each replica's block at its rank offset
+    (≙ test_horovod_allgather, test_tensorflow.py:307-343)."""
+    size = hvd.size()
+    shape = (4,) + (7,) * (dim - 1)
+    stack = jnp.stack([jnp.full(shape, r, dtype) for r in range(size)])
+    out = hvd.allgather(hvd.shard(stack))
+    assert out.shape == (4 * size,) + shape[1:]
+    arr = np.asarray(out.astype(jnp.float64))
+    for r in range(size):
+        block = arr[r * 4:(r + 1) * 4]
+        assert (block == r).all(), f"replica {r} block corrupted"
+
+
+def test_allgather_replicated(hvd):
+    x = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    out = hvd.allgather(x)
+    assert out.shape == (2 * hvd.size(), 3)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.tile(np.asarray(x), (hvd.size(), 1)))
+
+
+def test_allgather_variable_sizes(hvd):
+    """Variable dim-0 per replica — the MPI_Allgatherv path, requiring the
+    size-negotiation round (≙ test_horovod_allgather_variable_size,
+    test_tensorflow.py:345-391)."""
+    size = hvd.size()
+    sizes = [(r % 3) + 1 for r in range(size)]
+    pieces = [jnp.full((sizes[r], 5), r, jnp.float32) for r in range(size)]
+    out = hvd.allgather(pieces)
+    assert out.shape == (sum(sizes), 5)
+    arr = np.asarray(out)
+    off = 0
+    for r in range(size):
+        block = arr[off:off + sizes[r]]
+        assert (block == r).all()
+        off += sizes[r]
+
+
+def test_allgather_ndim_mismatch_raises(hvd):
+    if hvd.size() < 2:
+        pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.wire import Request, RequestType, DataType
+
+    st = __import__("horovod_tpu").core.state.global_state()
+    name = "gather.mismatch.ndim"
+    for r in range(hvd.size()):
+        shape = (2, 3) if r % 2 == 0 else (2, 3, 4)
+        st.coordinator.submit(Request(r, RequestType.ALLGATHER,
+                                      DataType.FLOAT32, name, -1, -1, shape))
+    resps = st.coordinator.poll_responses({name: 24})
+    assert resps[0].response_type.name == "ERROR"
+    assert "sent a tensor of rank" in resps[0].error_message
+
+
+def test_allgather_dim_mismatch_raises(hvd):
+    """Non-first dimension mismatch (first dim may differ, others not)
+    (≙ test_tensorflow.py:393-427)."""
+    if hvd.size() < 2:
+        pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.wire import Request, RequestType, DataType
+
+    st = __import__("horovod_tpu").core.state.global_state()
+    name = "gather.mismatch.dim"
+    for r in range(hvd.size()):
+        shape = (2, 3) if r % 2 == 0 else (5, 4)
+        st.coordinator.submit(Request(r, RequestType.ALLGATHER,
+                                      DataType.FLOAT32, name, -1, -1, shape))
+    resps = st.coordinator.poll_responses({name: 24})
+    assert resps[0].response_type.name == "ERROR"
+    assert "dimension 1" in resps[0].error_message
+
+
+def test_allgather_list_through_public_api_with_mismatch(hvd):
+    """Ragged non-first dims through the public list API raise
+    HorovodError end-to-end."""
+    if hvd.size() < 2:
+        pytest.skip("needs >1 replica")
+    pieces = [jnp.zeros((2, 3 + (r % 2)), jnp.float32)
+              for r in range(hvd.size())]
+    with pytest.raises(Exception) as ei:
+        hvd.allgather(pieces)
+    assert "Mismatched" in str(ei.value) or "dimension" in str(ei.value)

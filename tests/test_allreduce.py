@@ -1,0 +1,147 @@
+"""Allreduce tests — self-verifying collectives over a dtype × dims matrix
+(≙ reference test/test_tensorflow.py:34-97, test/test_torch.py:26-166)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+DTYPES = [jnp.uint8, jnp.int8, jnp.int32, jnp.int64, jnp.float32,
+          jnp.bfloat16]
+DIMS = [1, 2, 3]
+
+
+def _per_replica_tensor(size, dim, dtype, seed=0):
+    """Each replica contributes a distinct tensor (rank r → value r+1)."""
+    rng = np.random.RandomState(seed)
+    base = rng.randint(1, 4, size=(17,) * dim).astype(np.float64)
+    stack = np.stack([(base * (r + 1)) for r in range(size)])
+    return jnp.asarray(stack).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("dim", DIMS)
+def test_allreduce_per_replica(hvd, dtype, dim):
+    """Sum across replicas with distinct per-replica values
+    (≙ test_horovod_allreduce, test_tensorflow.py:34-63)."""
+    size = hvd.size()
+    stack = _per_replica_tensor(size, dim, dtype)
+    x = hvd.shard(stack)
+    y = hvd.allreduce(x, average=False)
+    expected = np.asarray(stack.astype(jnp.float64)).sum(axis=0)
+    got = np.asarray(y.astype(jnp.float64))
+    assert got.shape == stack.shape
+    for r in range(size):
+        np.testing.assert_allclose(got[r], expected, rtol=1e-2)
+
+
+def test_allreduce_replicated_value(hvd):
+    """A plain array is every replica's identical contribution → x * size."""
+    x = jnp.arange(12.0, dtype=jnp.float32).reshape(3, 4)
+    y = hvd.allreduce(x, average=False)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) * hvd.size(),
+                               rtol=1e-6)
+
+
+def test_allreduce_average(hvd):
+    size = hvd.size()
+    stack = jnp.stack([jnp.full((5,), float(r), jnp.float32)
+                       for r in range(size)])
+    y = hvd.allreduce(hvd.shard(stack), average=True)
+    expected = np.mean(np.arange(size, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(y)[0], np.full((5,), expected),
+                               rtol=1e-6)
+
+
+def test_allreduce_average_integer_floor(hvd):
+    """Integer average floors, matching the reference's in-place integer
+    divide (torch/tensor_util.h DivideTensorInPlace)."""
+    size = hvd.size()
+    stack = jnp.stack([jnp.full((3,), r, jnp.int32) for r in range(size)])
+    y = hvd.allreduce(hvd.shard(stack), average=True)
+    expected = sum(range(size)) // size
+    assert np.asarray(y)[0].tolist() == [expected] * 3
+
+
+def test_allreduce_async_fused(hvd):
+    """Many async allreduces before any synchronize: exercises the fusion
+    path and asserts poll() returned False at least once, i.e. the API is
+    genuinely asynchronous (≙ test_horovod_allreduce_async_fused,
+    test_torch.py:124-166)."""
+    size = hvd.size()
+    tensors = [jnp.full((50, 50), float(i), jnp.float32) for i in range(20)]
+    handles = [hvd.allreduce_async(t, average=False, name=f"fuse.{i}")
+               for i, t in enumerate(tensors)]
+    seen_not_ready = any(not hvd.poll(h) for h in handles)
+    results = [hvd.synchronize(h) for h in handles]
+    for i, r in enumerate(results):
+        np.testing.assert_allclose(np.asarray(r),
+                                   np.full((50, 50), i * size), rtol=1e-6)
+    # Async-ness: with 20 queued ops at least one poll should have preceded
+    # execution.  (Kept as a soft signal exactly like the reference, which
+    # asserts it only for large tensor counts.)
+    assert seen_not_ready or size == 1
+
+
+def test_allreduce_shape_mismatch_raises(hvd):
+    """Cross-replica shape mismatch → validation error on every replica
+    (≙ test_horovod_allreduce_error, test_tensorflow.py:233-258)."""
+    if hvd.size() < 2:
+        pytest.skip("needs >1 replica")
+    # Build two half-sized per-replica groups with conflicting shapes under
+    # the same tensor name by submitting raw requests through the queue.
+    from horovod_tpu.ops import collective as C
+    from horovod_tpu.ops.wire import Request, RequestType, DataType
+
+    st = C._state.global_state()
+    name = "mismatch.shape"
+    for r in range(hvd.size()):
+        shape = (2, 3) if r % 2 == 0 else (3, 2)
+        st.coordinator.submit(Request(r, RequestType.ALLREDUCE,
+                                      DataType.FLOAT32, name, -1, -1, shape))
+    resps = st.coordinator.poll_responses({name: 24})
+    assert len(resps) == 1
+    assert resps[0].response_type.name == "ERROR"
+    assert "Mismatched allreduce tensor shapes" in resps[0].error_message
+
+
+def test_allreduce_dtype_mismatch_raises(hvd):
+    if hvd.size() < 2:
+        pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.wire import Request, RequestType, DataType
+
+    st = __import__("horovod_tpu").core.state.global_state()
+    name = "mismatch.dtype"
+    for r in range(hvd.size()):
+        dt = DataType.FLOAT32 if r % 2 == 0 else DataType.INT32
+        st.coordinator.submit(Request(r, RequestType.ALLREDUCE, dt, name,
+                                      -1, -1, (3,)))
+    resps = st.coordinator.poll_responses({name: 12})
+    assert resps[0].response_type.name == "ERROR"
+    assert "Mismatched data types" in resps[0].error_message
+
+
+def test_mismatched_operations_raise(hvd):
+    """One replica allreduces while another allgathers the same name
+    (≙ mpi_ops mismatch tests, test_tensorflow.py:259-305)."""
+    if hvd.size() < 2:
+        pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.wire import Request, RequestType, DataType
+
+    st = __import__("horovod_tpu").core.state.global_state()
+    name = "mismatch.op"
+    for r in range(hvd.size()):
+        op = RequestType.ALLREDUCE if r % 2 == 0 else RequestType.ALLGATHER
+        st.coordinator.submit(Request(r, op, DataType.FLOAT32, name,
+                                      -1, -1, (3,)))
+    resps = st.coordinator.poll_responses({name: 12})
+    assert resps[0].response_type.name == "ERROR"
+    assert "Mismatched collective operations" in resps[0].error_message
+
+
+def test_allreduce_scalar(hvd):
+    """Rank-0 (scalar) tensors allreduce fine — the reference injects a
+    dummy dimension for these (torch/adapter.cc:64-73); XLA needs no such
+    workaround."""
+    y = hvd.allreduce(jnp.float32(2.5), average=False)
+    np.testing.assert_allclose(float(y), 2.5 * hvd.size(), rtol=1e-6)

@@ -1,0 +1,47 @@
+"""Process-info API tests (≙ reference test/test_common.py:26-74, which
+checks hvd.rank()/size() against the launcher's env vars; here topology
+comes from the JAX device enumeration)."""
+
+import jax
+import numpy as np
+import pytest
+
+
+def test_size_and_ranks(hvd):
+    assert hvd.size() == len(jax.devices())
+    assert hvd.local_size() == len(jax.devices())
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.process_index() == 0
+    assert hvd.process_count() == 1
+
+
+def test_mpi_threads_supported(hvd):
+    assert hvd.mpi_threads_supported() is True
+
+
+def test_subset_init(hvd2):
+    assert hvd2.size() == 2
+
+
+def test_not_initialized_raises():
+    import horovod_tpu as hvd
+
+    if hvd.is_initialized():
+        hvd.shutdown()
+    with pytest.raises(hvd.NotInitializedError):
+        hvd.size()
+    with pytest.raises(hvd.NotInitializedError):
+        hvd.allreduce(np.ones(3))
+
+
+def test_reinit_is_idempotent(hvd):
+    n = hvd.size()
+    hvd.init()
+    assert hvd.size() == n
+
+
+def test_mesh_axis(hvd):
+    m = hvd.mesh()
+    assert m.axis_names == (hvd.REPLICA_AXIS,)
+    assert m.devices.size == hvd.size()

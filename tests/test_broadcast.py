@@ -1,0 +1,76 @@
+"""Broadcast tests: root semantics, every root rank, mismatch errors
+(≙ reference test_tensorflow.py:429-509, test_torch.py:409-533)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_broadcast_all_roots(hvd):
+    """For every possible root, all replicas receive the root's tensor
+    (≙ test_horovod_broadcast, test_tensorflow.py:429-457)."""
+    size = hvd.size()
+    stack = jnp.stack([jnp.full((3, 3), float(r), jnp.float32)
+                       for r in range(size)])
+    for root in range(size):
+        out = hvd.broadcast(hvd.shard(stack), root_rank=root,
+                            name=f"bcast.{root}")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((3, 3), float(root)))
+
+
+def test_broadcast_replicated_identity(hvd):
+    x = jnp.arange(4.0, dtype=jnp.float32)
+    out = hvd.broadcast(x, root_rank=0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+def test_broadcast_invalid_root(hvd):
+    with pytest.raises(ValueError):
+        hvd.broadcast(jnp.ones(2), root_rank=hvd.size())
+
+
+def test_broadcast_root_rank_mismatch_raises(hvd):
+    """Replicas disagreeing on the root is a negotiation error
+    (≙ test_horovod_broadcast_rank_error, test_tensorflow.py:459-509)."""
+    if hvd.size() < 2:
+        pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.wire import Request, RequestType, DataType
+
+    st = __import__("horovod_tpu").core.state.global_state()
+    name = "bcast.mismatch.root"
+    for r in range(hvd.size()):
+        st.coordinator.submit(Request(r, RequestType.BROADCAST,
+                                      DataType.FLOAT32, name,
+                                      root_rank=r % 2, device=-1,
+                                      tensor_shape=(3,)))
+    resps = st.coordinator.poll_responses({name: 12})
+    assert resps[0].response_type.name == "ERROR"
+    assert "Mismatched broadcast root ranks" in resps[0].error_message
+
+
+def test_broadcast_shape_mismatch_raises(hvd):
+    if hvd.size() < 2:
+        pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.wire import Request, RequestType, DataType
+
+    st = __import__("horovod_tpu").core.state.global_state()
+    name = "bcast.mismatch.shape"
+    for r in range(hvd.size()):
+        shape = (3,) if r % 2 == 0 else (4,)
+        st.coordinator.submit(Request(r, RequestType.BROADCAST,
+                                      DataType.FLOAT32, name,
+                                      root_rank=0, device=-1,
+                                      tensor_shape=shape))
+    resps = st.coordinator.poll_responses({name: 12})
+    assert resps[0].response_type.name == "ERROR"
+    assert "Mismatched broadcast tensor shapes" in resps[0].error_message
+
+
+def test_broadcast_parameters_pytree(hvd):
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros(4),
+              "nested": {"x": jnp.full((2,), 7.0)}}
+    out = hvd.broadcast_parameters(params, root_rank=0)
+    assert set(out.keys()) == {"w", "b", "nested"}
+    np.testing.assert_allclose(np.asarray(out["nested"]["x"]),
+                               np.full((2,), 7.0), rtol=1e-6)

@@ -1,0 +1,123 @@
+"""Coordinator unit tests: readiness counting, fusion planning, stall
+detection, wire round-trip (≙ the machinery of reference
+operations.cc:222-461, :1072-1115, :1328-1374 and mpi_message.cc)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.coordinator import PyCoordinator, STALL_WARNING_SECONDS
+from horovod_tpu.ops.wire import (DataType, Request, RequestType, Response,
+                                  ResponseType, pack_response_list,
+                                  unpack_response_list)
+
+
+def _req(rank, name, shape=(4,), op=RequestType.ALLREDUCE,
+         dtype=DataType.FLOAT32, root=-1, device=-1):
+    return Request(rank, op, dtype, name, root, device, shape)
+
+
+def test_readiness_counting():
+    """A tensor becomes ready only when all replicas submitted
+    (≙ IncrementTensorCount, operations.cc:222-247)."""
+    c = PyCoordinator(size=4, fusion_threshold=1 << 20)
+    for r in range(3):
+        assert c.submit(_req(r, "t")) is False
+    assert c.submit(_req(3, "t")) is True
+    resps = c.poll_responses({"t": 16})
+    assert len(resps) == 1
+    assert resps[0].response_type == ResponseType.ALLREDUCE
+    assert resps[0].tensor_names == ["t"]
+
+
+def test_duplicate_rank_rejected():
+    c = PyCoordinator(size=2, fusion_threshold=0)
+    c.submit(_req(0, "t"))
+    with pytest.raises(ValueError):
+        c.submit(_req(0, "t"))
+
+
+def test_fusion_same_dtype_under_threshold():
+    """Two small float32 allreduces fuse into one response; an int32 one
+    does not join them (fusion requires matching dtype, as the reference's
+    fusion-buffer requires one dtype per buffer)."""
+    c = PyCoordinator(size=2, fusion_threshold=1024)
+    for name in ("a", "b"):
+        for r in range(2):
+            c.submit(_req(r, name))
+    for r in range(2):
+        c.submit(_req(r, "c", dtype=DataType.INT32))
+    resps = c.poll_responses({"a": 16, "b": 16, "c": 16})
+    fused = [r for r in resps if len(r.tensor_names) > 1]
+    assert len(fused) == 1
+    assert sorted(fused[0].tensor_names) == ["a", "b"]
+
+
+def test_fusion_threshold_respected():
+    """Tensors stop fusing once the byte budget is exhausted
+    (≙ operations.cc:1328-1360; HOROVOD_FUSION_THRESHOLD semantics)."""
+    c = PyCoordinator(size=1, fusion_threshold=100)
+    for name in ("a", "b", "c"):
+        c.submit(_req(0, name))
+    # a=60B, b=60B (won't fit with a), c=30B (fits with a: 90 <= 100).
+    resps = c.poll_responses({"a": 60, "b": 60, "c": 30})
+    names = [tuple(sorted(r.tensor_names)) for r in resps
+             if r.response_type == ResponseType.ALLREDUCE]
+    assert ("a", "c") in names
+    assert ("b",) in names
+
+
+def test_fusion_disabled_with_zero_threshold():
+    c = PyCoordinator(size=1, fusion_threshold=0)
+    for name in ("a", "b"):
+        c.submit(_req(0, name))
+    resps = c.poll_responses({"a": 8, "b": 8})
+    assert all(len(r.tensor_names) == 1 for r in resps)
+
+
+def test_stall_detection():
+    """Tensors pending longer than the threshold are reported with ready
+    and missing replica lists (≙ CheckForStalledTensors,
+    operations.cc:1072-1115)."""
+    c = PyCoordinator(size=4, fusion_threshold=0)
+    c.submit(_req(0, "stuck"), now=0.0)
+    c.submit(_req(2, "stuck"), now=1.0)
+    warnings = c.check_stalled(now=STALL_WARNING_SECONDS + 2.0)
+    assert len(warnings) == 1
+    w = warnings[0]
+    assert "stuck" in w
+    assert "[0, 2]" in w       # ready replicas
+    assert "[1, 3]" in w       # missing replicas
+    # Under the threshold: no warning.
+    assert c.check_stalled(now=30.0) == []
+
+
+def test_wire_roundtrip():
+    """Request/Response serialize → parse losslessly (≙ the flatbuffers
+    round-trip, mpi_message.cc:118-163, :290-324)."""
+    r = Request(3, RequestType.ALLGATHER, DataType.BFLOAT16,
+                "layer1/weights:0", root_rank=2, device=5,
+                tensor_shape=(128, 256, 3))
+    buf = r.pack()
+    r2, off = Request.unpack(buf)
+    assert off == len(buf)
+    assert r2 == r
+
+    resp = Response(ResponseType.ALLGATHER, ["a", "b"], "",
+                    devices=[0, 1, 2], tensor_sizes=[5, 7, 9])
+    buf = pack_response_list([resp, Response(ResponseType.ERROR, ["x"],
+                                             "boom message")])
+    out = unpack_response_list(buf)
+    assert out[0] == resp
+    assert out[1].error_message == "boom message"
+    assert out[1].response_type == ResponseType.ERROR
+
+
+def test_device_mismatch_detected():
+    """Host tensor on one replica, device tensor on another → error
+    (≙ the CPU-vs-GPU placement mismatch test, test_tensorflow.py:459+)."""
+    c = PyCoordinator(size=2, fusion_threshold=0)
+    c.submit(_req(0, "t", device=-1))
+    c.submit(_req(1, "t", device=0))
+    resps = c.poll_responses({"t": 16})
+    assert resps[0].response_type == ResponseType.ERROR
+    assert "device" in resps[0].error_message
