@@ -1,0 +1,106 @@
+"""Benchmark: ResNet-50 data-parallel training throughput (images/sec/chip).
+
+Mirrors the reference's headline benchmark — ResNet training throughput
+with synthetic ImageNet data via tf_cnn_benchmarks
+(docs/benchmarks.md:22-40): ResNet-101, batch 64/GPU on 16 Pascal GPUs
+reached 1656.82 images/sec total = 103.55 images/sec/GPU.  That per-chip
+number is the ``vs_baseline`` denominator here.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": N}
+
+Usage:
+  python bench.py            # full run (real TPU; batch 128, ~2 min)
+  python bench.py --smoke    # tiny shapes (CPU-friendly sanity check)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Reference: 1656.82 images/sec on 16 GPUs (docs/benchmarks.md:22-40).
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 1656.82 / 16
+
+
+def run(batch_size: int, image_size: int, warmup: int, iters: int,
+        model_ctor=None, num_classes: int = 1000) -> float:
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import resnet as R
+    from horovod_tpu.parallel.training import (make_train_step_with_state,
+                                               shard_batch)
+
+    hvd.init()
+    n_chips = hvd.size()
+    model = (model_ctor or R.ResNet50)(num_classes=num_classes)
+    params, stats = R.init_resnet(model, image_size=image_size,
+                                  batch_size=batch_size)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    # The reference benchmark recipe: SGD with momentum, synthetic data
+    # (docs/benchmarks.md:28-33).
+    opt = optax.sgd(0.1, momentum=0.9)
+    loss_fn = R.resnet_loss_fn(model)
+    step = make_train_step_with_state(loss_fn, opt)
+
+    global_batch = batch_size * n_chips
+    images, labels = R.synthetic_imagenet(global_batch,
+                                          image_size=image_size,
+                                          num_classes=num_classes)
+    batch = shard_batch((jnp.asarray(images), jnp.asarray(labels)))
+    opt_state = opt.init(params)
+
+    for _ in range(warmup):
+        params, stats, opt_state, loss = step(params, stats, opt_state,
+                                              batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, stats, opt_state, loss = step(params, stats, opt_state,
+                                              batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    images_per_sec_total = global_batch * iters / dt
+    return images_per_sec_total / n_chips
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for CPU sanity checks")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+
+    if args.smoke:
+        from horovod_tpu.models.resnet import ResNet18Thin
+
+        value = run(batch_size=8, image_size=32, warmup=1, iters=3,
+                    model_ctor=ResNet18Thin, num_classes=16)
+    else:
+        value = run(batch_size=args.batch_size, image_size=args.image_size,
+                    warmup=args.warmup, iters=args.iters)
+
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""Data-parallel MNIST — ≙ the reference's examples/tensorflow_mnist.py.
+
+Usage (8 virtual replicas on CPU):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/jax_mnist.py
+
+The reference structure (examples/tensorflow_mnist.py:83-119): init, build
+model, wrap optimizer in DistributedOptimizer, broadcast initial variables,
+train, checkpoint on rank 0.  Same flow here, with the step compiled as one
+SPMD program.
+"""
+
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, ".")
+
+import horovod_tpu as hvd
+from horovod_tpu.models.mnist import (MnistCNN, cross_entropy_loss, accuracy,
+                                      init_params, synthetic_mnist)
+from horovod_tpu.parallel.training import (make_train_step, make_eval_step,
+                                           shard_batch)
+from horovod_tpu.utils.checkpoint import save_checkpoint
+
+
+def main():
+    hvd.init()
+    print(f"replicas={hvd.size()} local={hvd.local_size()}")
+
+    model = MnistCNN()
+    params = init_params(model)
+    # Replica-consistent start (≙ BroadcastGlobalVariablesHook).
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    def loss_fn(params, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": params}, images),
+                                  labels)
+
+    # Scale LR by replica count, as the reference README prescribes
+    # (README.md:90-91).
+    opt = optax.sgd(0.01 * hvd.size(), momentum=0.9)
+    opt_state = opt.init(params)
+    step = make_train_step(loss_fn, opt)
+
+    images, labels = synthetic_mnist(2048)
+    global_batch = 16 * hvd.size()
+    steps_per_epoch = len(images) // global_batch
+
+    for epoch in range(2):
+        perm = np.random.RandomState(epoch).permutation(len(images))
+        for s in range(steps_per_epoch):
+            idx = perm[s * global_batch:(s + 1) * global_batch]
+            batch = shard_batch((jnp.asarray(images[idx]),
+                                 jnp.asarray(labels[idx])))
+            params, opt_state, loss = step(params, opt_state, batch)
+        print(f"epoch {epoch}: loss={float(loss):.4f}")
+
+    def metric_fn(params, batch):
+        imgs, lbls = batch
+        return accuracy(model.apply({"params": params}, imgs), lbls)
+
+    ev = make_eval_step(metric_fn)
+    acc = ev(params, shard_batch((jnp.asarray(images[:512]),
+                                  jnp.asarray(labels[:512]))))
+    print(f"train-set accuracy: {float(acc):.3f}")
+
+    # Checkpoint from the coordinating process only (README.md:102-104).
+    if save_checkpoint("/tmp/horovod_tpu_mnist/ckpt.msgpack", params):
+        print("checkpoint saved")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

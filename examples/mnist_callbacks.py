@@ -1,0 +1,62 @@
+"""MNIST with the callback suite — ≙ examples/keras_mnist_advanced.py:
+broadcast-init, metric averaging, gradual LR warmup, LR schedule.
+
+Usage:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/mnist_callbacks.py
+"""
+
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import horovod_tpu as hvd
+import horovod_tpu.callbacks as callbacks
+from horovod_tpu.frontends.loop import Trainer
+from horovod_tpu.models.mnist import (MnistMLP, cross_entropy_loss,
+                                      init_params, synthetic_mnist)
+
+
+def main():
+    hvd.init()
+    model = MnistMLP(hidden=128)
+    params = init_params(model)
+
+    def loss_fn(params, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": params}, images),
+                                  labels)
+
+    steps_per_epoch = 16
+    trainer = Trainer(
+        loss_fn, params, lr=0.1 * hvd.size(),
+        optimizer_kwargs={"momentum": 0.9},
+        callbacks=[
+            # ≙ keras_mnist_advanced.py's callback stack.
+            callbacks.BroadcastGlobalVariablesCallback(0),
+            callbacks.MetricAverageCallback(),
+            callbacks.LearningRateWarmupCallback(
+                warmup_epochs=2, steps_per_epoch=steps_per_epoch, verbose=1),
+            callbacks.LearningRateScheduleCallback(
+                multiplier=0.1, start_epoch=4),
+        ])
+
+    images, labels = synthetic_mnist(4096)
+    global_batch = 32 * hvd.size()
+
+    def batches(epoch, step):
+        rng = np.random.RandomState(epoch * 1000 + step)
+        idx = rng.randint(0, len(images), size=global_batch)
+        return (jnp.asarray(images[idx]), jnp.asarray(labels[idx]))
+
+    history = trainer.fit(batches, epochs=6, steps_per_epoch=steps_per_epoch)
+    for e, logs in enumerate(history):
+        print(f"epoch {e}: {logs}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""ResNet-50 distributed training — ≙ examples/keras_imagenet_resnet50.py,
+the reference's flagship: checkpoint-resume with broadcast, LR warmup +
+staircase decay, rank-0 checkpointing, verbose on rank 0 only.
+
+Synthetic ImageNet data (as the reference's published benchmarks use,
+docs/benchmarks.md:28-33).  Sized down by default so it runs anywhere; pass
+--full for benchmark shapes.
+
+Usage:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/resnet50_synthetic.py
+"""
+
+import argparse
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import horovod_tpu as hvd
+import horovod_tpu.callbacks as callbacks
+from horovod_tpu.frontends.loop import Trainer
+from horovod_tpu.models import resnet as R
+from horovod_tpu.utils.checkpoint import (restore_checkpoint, resume_epoch,
+                                          save_checkpoint)
+
+CKPT = "/tmp/horovod_tpu_resnet50/ckpt.msgpack"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true",
+                    help="benchmark shapes (224px ResNet-50)")
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    hvd.init()
+    verbose = hvd.rank() == 0
+
+    if args.full:
+        model = R.ResNet50(num_classes=1000)
+        image_size, num_classes, per_chip = 224, 1000, 32
+    else:
+        model = R.ResNet18Thin(num_classes=16)
+        image_size, num_classes, per_chip = 32, 16, 8
+
+    params, stats = R.init_resnet(model, image_size=image_size)
+
+    # Resume: restore on the coordinator, broadcast, and agree on the epoch
+    # (≙ keras_imagenet_resnet50.py:47-56, :130-133).  Both params and BN
+    # statistics are checkpointed.
+    start_epoch = 0
+    if os.path.exists(CKPT):
+        restored = restore_checkpoint(
+            CKPT, {"params": params, "batch_stats": stats})
+        params, stats = restored["params"], restored["batch_stats"]
+        start_epoch = resume_epoch(CKPT)
+        if verbose:
+            print(f"resumed from epoch {start_epoch}")
+
+    loss_fn = R.resnet_loss_fn(model)
+    steps_per_epoch = 8
+    base_lr = 0.0125 * hvd.size()  # linear LR scaling (README.md:90-91)
+
+    trainer = Trainer(
+        loss_fn, params, lr=base_lr, optimizer_kwargs={"momentum": 0.9},
+        model_state=stats,
+        callbacks=[
+            callbacks.BroadcastGlobalVariablesCallback(0),
+            callbacks.MetricAverageCallback(),
+            callbacks.LearningRateWarmupCallback(
+                warmup_epochs=1, steps_per_epoch=steps_per_epoch,
+                verbose=int(verbose)),
+            # 30/60/80-style staircase, scaled to the toy epoch count.
+            callbacks.LearningRateScheduleCallback(
+                multiplier=0.1, start_epoch=2),
+        ])
+
+    global_batch = per_chip * hvd.size()
+    images, labels = R.synthetic_imagenet(
+        4 * global_batch, image_size=image_size, num_classes=num_classes)
+
+    def batches(epoch, step):
+        rng = np.random.RandomState(epoch * 131 + step)
+        idx = rng.randint(0, len(images), size=global_batch)
+        return (jnp.asarray(images[idx]), jnp.asarray(labels[idx]))
+
+    history = trainer.fit(batches, epochs=args.epochs,
+                          steps_per_epoch=steps_per_epoch,
+                          initial_epoch=start_epoch)
+    if verbose:
+        for e, logs in enumerate(history):
+            print(f"epoch {start_epoch + e}: {logs}")
+
+    if save_checkpoint(CKPT, {"params": trainer.params,
+                              "batch_stats": trainer.model_state},
+                       step=args.epochs):
+        print("checkpoint saved")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

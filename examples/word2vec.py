@@ -1,0 +1,65 @@
+"""Skip-gram word2vec with sparse gradient exchange — ≙ the reference's
+examples/tensorflow_word2vec.py (the workload that exercises the
+IndexedSlices → allgather sparse allreduce path,
+tensorflow/__init__.py:67-78).
+
+Usage:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/word2vec.py
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import horovod_tpu as hvd
+from horovod_tpu.models import word2vec as W
+from horovod_tpu.ops import sparse as S
+
+
+def main():
+    hvd.init()
+    vocab, dim = 2000, 128
+    params = W.init_params(vocab, dim)
+    corpus = W.synthetic_corpus(vocab, 100_000)
+    rng = np.random.RandomState(hvd.rank())
+    lr = 0.2
+
+    @jax.jit
+    def grads_fn(emb, nce_w, nce_b, centers, targets, negs):
+        def loss(emb, nce_w, nce_b):
+            p = W.Word2VecParams(emb, nce_w, nce_b)
+            return W.nce_loss(p, centers, targets, negs)
+        return jax.value_and_grad(loss, argnums=(0, 1, 2))(emb, nce_w, nce_b)
+
+    for step in range(100):
+        centers, targets = W.skipgram_batch(rng, corpus, batch_size=128)
+        negs = rng.randint(0, vocab, size=64).astype("int32")
+        loss, (g_emb, g_w, g_b) = grads_fn(
+            params.embeddings, params.nce_weights, params.nce_biases,
+            jnp.asarray(centers), jnp.asarray(targets), jnp.asarray(negs))
+
+        # Embedding gradient: sparse exchange (touched rows only), exactly
+        # the reference's device_sparse path.
+        sl = S.sparse_grad_from_dense(g_emb, jnp.asarray(centers))
+        sl = S.allreduce(sl, average=True, name=f"w2v.emb.{step}")
+        new_emb = S.apply_to(params.embeddings, sl, scale=-lr)
+
+        # NCE weights/biases: dense averaged allreduce.
+        g_w = hvd.allreduce(g_w, name=f"w2v.w.{step}")
+        g_b = hvd.allreduce(g_b, name=f"w2v.b.{step}")
+        params = W.Word2VecParams(
+            new_emb, params.nce_weights - lr * g_w,
+            params.nce_biases - lr * g_b)
+        if step % 20 == 0:
+            print(f"step {step}: loss={float(loss):.4f}")
+    print(f"final loss={float(loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -134,6 +134,13 @@ def _eager_allreduce_grads(grads, average: bool = True):
     from ..ops import collective as C
 
     leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if any(isinstance(g, jax.core.Tracer) for g in leaves):
+        raise RuntimeError(
+            "DistributedOptimizer.update was traced (jit) outside a replica "
+            "context. Either call it inside shard_map/pmap over the "
+            f"'{REPLICA_AXIS}' axis, or build the step with "
+            "horovod_tpu.parallel.training.make_train_step, which wires the "
+            "reduction into the SPMD program.")
     handles = [
         C.allreduce_async(g, average=average, name=f"grad.{i}")
         for i, g in enumerate(leaves)

@@ -1,0 +1,143 @@
+"""Callback + Trainer + checkpoint tests (≙ reference keras/callbacks.py
+semantics and the rank-0/broadcast checkpoint conventions)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu.callbacks as hvd_callbacks
+from horovod_tpu.frontends.loop import Trainer
+from horovod_tpu.models.mnist import (MnistMLP, cross_entropy_loss,
+                                      init_params, synthetic_mnist)
+from horovod_tpu.utils.checkpoint import (restore_checkpoint, resume_epoch,
+                                          save_checkpoint)
+
+
+def _make_trainer(hvd, callbacks, lr=0.1, momentum=None, steps=4):
+    model = MnistMLP(hidden=16)
+    params = init_params(model)
+
+    def loss_fn(params, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": params}, images),
+                                  labels)
+
+    kwargs = {"momentum": momentum} if momentum is not None else {}
+    return Trainer(loss_fn, params, optimizer_fn=optax.sgd, lr=lr,
+                   optimizer_kwargs=kwargs, callbacks=callbacks)
+
+
+def _batches(images, labels):
+    def get(epoch, step):
+        return (jnp.asarray(images), jnp.asarray(labels))
+    return get
+
+
+def test_warmup_ramps_lr_from_lr_over_size(hvd):
+    """lr starts at ~initial/size and reaches initial after warmup
+    (≙ keras/callbacks.py:202-227 math)."""
+    lrs = []
+
+    class Spy(hvd_callbacks.Callback):
+        def on_batch_begin(self, batch, logs=None):
+            lrs.append(self.trainer.lr)
+
+    warmup = hvd_callbacks.LearningRateWarmupCallback(
+        warmup_epochs=2, steps_per_epoch=4, momentum_correction=False)
+    trainer = _make_trainer(hvd, [warmup, Spy()], lr=0.8)
+    images, labels = synthetic_mnist(32)
+    trainer.fit(_batches(images, labels), epochs=3, steps_per_epoch=4)
+
+    size = hvd.size()
+    # First adjusted batch follows the reference formula exactly
+    # (keras/callbacks.py:243-247): epoch' = 0 + 1/steps, multiplier =
+    # 1/size * (epoch' * (size-1)/warmup + 1).
+    first_epoch = 1.0 / 4
+    expected_first = 0.8 / size * (first_epoch * (size - 1) / 2 + 1)
+    assert min(lrs) == pytest.approx(expected_first, rel=1e-4)
+    assert max(lrs) == pytest.approx(0.8, rel=0.05)
+    # After warmup epochs end, lr stays at initial.
+    assert lrs[-1] == pytest.approx(0.8, rel=0.05)
+
+
+def test_schedule_staircase_and_momentum_correction(hvd):
+    events = []
+
+    class Spy(hvd_callbacks.Callback):
+        def on_batch_begin(self, batch, logs=None):
+            events.append((round(self.trainer.lr, 5),
+                           round(self.trainer.momentum, 5)))
+
+    sched = hvd_callbacks.LearningRateScheduleCallback(
+        multiplier=lambda e: 0.1 if e >= 1 else 1.0, staircase=True,
+        momentum_correction=True)
+    # Order matters: schedule first so Spy sees the post-adjustment state
+    # within the same batch.
+    trainer = _make_trainer(hvd, [sched, Spy()], lr=0.5, momentum=0.9)
+    images, labels = synthetic_mnist(32)
+    trainer.fit(_batches(images, labels), epochs=2, steps_per_epoch=3)
+
+    # Epoch 0: lr 0.5; epoch 1: lr 0.05.
+    assert events[0][0] == pytest.approx(0.5)
+    assert events[3][0] == pytest.approx(0.05)
+    # Momentum corrected by new/old ratio on the adjusting batch, then
+    # restored at batch end (the Spy for batch 1 of epoch 1 sees restored).
+    assert events[3][1] == pytest.approx(0.9 * 0.1, rel=1e-3)
+    assert events[4][1] == pytest.approx(0.9, rel=1e-3)
+
+
+def test_metric_average_callback(hvd):
+    logs = {"loss": 4.0, "acc": 0.5}
+    cb = hvd_callbacks.MetricAverageCallback()
+    cb.on_epoch_end(0, logs)
+    # Replicated values: average across replicas is the identity.
+    assert logs["loss"] == pytest.approx(4.0)
+    assert logs["acc"] == pytest.approx(0.5)
+
+
+def test_broadcast_callback_runs(hvd):
+    cb = hvd_callbacks.BroadcastGlobalVariablesCallback(0)
+    trainer = _make_trainer(hvd, [cb], lr=0.05)
+    images, labels = synthetic_mnist(32)
+    hist = trainer.fit(_batches(images, labels), epochs=1, steps_per_epoch=2)
+    assert len(hist) == 1 and np.isfinite(hist[0]["loss"])
+
+
+def test_training_with_warmup_still_learns(hvd):
+    warmup = hvd_callbacks.LearningRateWarmupCallback(
+        warmup_epochs=1, steps_per_epoch=8)
+    trainer = _make_trainer(hvd, [warmup], lr=0.5, momentum=0.9)
+    images, labels = synthetic_mnist(128)
+    hist = trainer.fit(_batches(images, labels), epochs=4, steps_per_epoch=8)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_checkpoint_roundtrip_and_resume(hvd, tmp_path):
+    params = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(3)}
+    path = str(tmp_path / "ckpt.msgpack")
+    assert save_checkpoint(path, params, step=7) is True
+    target = {"w": jnp.zeros((2, 3)), "b": jnp.zeros(3)}
+    restored = restore_checkpoint(path, target)
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.arange(6.0).reshape(2, 3))
+    assert resume_epoch(path) == 7
+
+
+def test_warmup_then_decay_schedule_segments(hvd):
+    """The optax-schedule variant: base LR holds between warmup end and the
+    first decay epoch; decays land at their own epochs."""
+    from horovod_tpu.callbacks import warmup_then_decay_schedule
+
+    spe = 10
+    sched = warmup_then_decay_schedule(
+        base_lr=1.0, warmup_epochs=2, steps_per_epoch=spe,
+        decay_epochs_and_factors=[(5, 0.1), (8, 0.01)])
+    size = __import__("horovod_tpu").size()
+    assert float(sched(0)) == pytest.approx(1.0 / size)
+    assert float(sched(2 * spe)) == pytest.approx(1.0)       # warmup done
+    assert float(sched(4 * spe)) == pytest.approx(1.0)       # still base
+    assert float(sched(5 * spe)) == pytest.approx(0.1)       # first decay
+    assert float(sched(8 * spe)) == pytest.approx(0.01)      # second decay
