@@ -57,6 +57,71 @@ def replicate(tree, mesh=None):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
 
 
+def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
+               has_aux, donate, has_state):
+    """Shared builder behind :func:`make_train_step` and
+    :func:`make_train_step_with_state` — one place wires the reduction,
+    pmean placement, shard_map specs and donation for both variants."""
+    mesh = mesh or _state.mesh()
+
+    if isinstance(optimizer, DistributedOptimizer):
+        average = optimizer._average
+        if optimizer._fusion_threshold is not None:
+            fusion_threshold = optimizer._fusion_threshold
+        optimizer = optimizer._inner
+
+    # The stateful loss returns (loss, new_state) — an aux output.
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux or has_state)
+
+    def per_replica(params, model_state, batch):
+        args = (params, model_state, batch) if has_state else (params, batch)
+        out, grads = grad_fn(*args)
+        loss = out[0] if (has_aux or has_state) else out
+        aux = out[1] if (has_aux or has_state) else None
+        # Fused cross-replica gradient reduction (Tensor Fusion over psum).
+        grads = allreduce_gradients(grads, average=average,
+                                    fusion_threshold=fusion_threshold)
+        # Report the global mean loss, like MetricAverageCallback would
+        # (keras/callbacks.py:37-87).  Aux outputs — metrics, or the
+        # updated BatchNorm statistics in the stateful variant — are
+        # averaged the same way; for BN stats this is synchronized
+        # BatchNorm riding the same compiled collective schedule as the
+        # gradients (the reference instead leaves stats per-worker and
+        # relies on rank-0 checkpointing, README.md:102-104).
+        loss = jax.lax.pmean(loss, REPLICA_AXIS)
+        aux = jax.tree_util.tree_map(
+            lambda x: jax.lax.pmean(x, REPLICA_AXIS), aux)
+        return loss, grads, aux
+
+    sharded = jax.shard_map(
+        per_replica, mesh=mesh,
+        in_specs=(P(), P(), P(REPLICA_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+
+    def apply(grads, opt_state, params):
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    if has_state:
+        def step(params, model_state, opt_state, batch):
+            loss, grads, model_state = sharded(params, model_state, batch)
+            params, opt_state = apply(grads, opt_state, params)
+            return params, model_state, opt_state, loss
+
+        donate_argnums = (0, 1, 2) if donate else ()
+    else:
+        def step(params, opt_state, batch):
+            loss, grads, aux = sharded(params, None, batch)
+            params, opt_state = apply(grads, opt_state, params)
+            if has_aux:
+                return params, opt_state, loss, aux
+            return params, opt_state, loss
+
+        donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
 def make_train_step(
     loss_fn: Callable[..., Any],
     optimizer,
@@ -84,49 +149,8 @@ def make_train_step(
       — one compiled SPMD program; batch's leading axis must be divisible by
       the replica count.
     """
-    mesh = mesh or _state.mesh()
-
-    if isinstance(optimizer, DistributedOptimizer):
-        average = optimizer._average
-        if optimizer._fusion_threshold is not None:
-            fusion_threshold = optimizer._fusion_threshold
-        optimizer = optimizer._inner
-
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
-
-    def per_replica(params, batch):
-        out, grads = grad_fn(params, batch)
-        loss = out[0] if has_aux else out
-        aux = out[1] if has_aux else None
-        # Fused cross-replica gradient reduction (Tensor Fusion over psum).
-        grads = allreduce_gradients(grads, average=average,
-                                    fusion_threshold=fusion_threshold)
-        # Report the global mean loss, like MetricAverageCallback would
-        # (keras/callbacks.py:37-87).  Aux outputs (metrics) are averaged
-        # the same way — this also keeps scalar aux leaves representable
-        # (they cannot be sharded over the replica axis).
-        loss = jax.lax.pmean(loss, REPLICA_AXIS)
-        if has_aux:
-            aux = jax.tree_util.tree_map(
-                lambda x: jax.lax.pmean(x, REPLICA_AXIS), aux)
-        return loss, grads, aux
-
-    sharded = jax.shard_map(
-        per_replica, mesh=mesh,
-        in_specs=(P(), P(REPLICA_AXIS)),
-        out_specs=(P(), P(), P()),
-        check_vma=False)
-
-    def step(params, opt_state, batch):
-        loss, grads, aux = sharded(params, batch)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        if has_aux:
-            return params, opt_state, loss, aux
-        return params, opt_state, loss
-
-    donate_argnums = (0, 1) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    return _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
+                      has_aux, donate, has_state=False)
 
 
 def make_train_step_with_state(
@@ -138,51 +162,15 @@ def make_train_step_with_state(
     donate: bool = True,
 ):
     """Train-step builder for models carrying non-trained state (BatchNorm
-    statistics): ``loss_fn(params, model_state, batch) -> (loss, new_state)``.
-
-    The reference leaves BN statistics per-worker and relies on rank-0
-    checkpointing + broadcast for consistency (README.md:102-104,
-    torch/__init__.py:125-152).  Replicas here share one compiled program,
-    so we go one better: the updated statistics are ``pmean``-ed across the
-    replica axis every step (synchronized BatchNorm at no extra wire cost —
-    the stats ride the same compiled collective schedule as the gradients).
+    statistics): ``loss_fn(params, model_state, batch) -> (loss, new_state)``;
+    the updated statistics are ``pmean``-ed every step (synchronized
+    BatchNorm).
 
     Returns ``step(params, model_state, opt_state, batch) ->
     (params, model_state, opt_state, loss)``.
     """
-    mesh = mesh or _state.mesh()
-
-    if isinstance(optimizer, DistributedOptimizer):
-        average = optimizer._average
-        if optimizer._fusion_threshold is not None:
-            fusion_threshold = optimizer._fusion_threshold
-        optimizer = optimizer._inner
-
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-
-    def per_replica(params, model_state, batch):
-        (loss, new_state), grads = grad_fn(params, model_state, batch)
-        grads = allreduce_gradients(grads, average=average,
-                                    fusion_threshold=fusion_threshold)
-        loss = jax.lax.pmean(loss, REPLICA_AXIS)
-        new_state = jax.tree_util.tree_map(
-            lambda x: jax.lax.pmean(x, REPLICA_AXIS), new_state)
-        return loss, grads, new_state
-
-    sharded = jax.shard_map(
-        per_replica, mesh=mesh,
-        in_specs=(P(), P(), P(REPLICA_AXIS)),
-        out_specs=(P(), P(), P()),
-        check_vma=False)
-
-    def step(params, model_state, opt_state, batch):
-        loss, grads, model_state = sharded(params, model_state, batch)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, model_state, opt_state, loss
-
-    donate_argnums = (0, 1, 2) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    return _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
+                      has_aux=False, donate=donate, has_state=True)
 
 
 def make_eval_step(metric_fn: Callable[..., Any], mesh=None):

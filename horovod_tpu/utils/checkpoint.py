@@ -59,7 +59,7 @@ def restore_checkpoint(path: str, target: Any, broadcast: bool = True) -> Any:
     this asymmetry)."""
     from flax import serialization
 
-    if _is_saving_process() or not _state.is_initialized():
+    if not _state.is_initialized() or _is_saving_process():
         with open(path, "rb") as f:
             blob = f.read()
         tree = serialization.from_bytes(target, blob)

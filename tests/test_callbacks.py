@@ -3,6 +3,7 @@ semantics and the rank-0/broadcast checkpoint conventions)."""
 
 import os
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -141,3 +142,20 @@ def test_warmup_then_decay_schedule_segments(hvd):
     assert float(sched(4 * spe)) == pytest.approx(1.0)       # still base
     assert float(sched(5 * spe)) == pytest.approx(0.1)       # first decay
     assert float(sched(8 * spe)) == pytest.approx(0.01)      # second decay
+
+
+def test_restore_checkpoint_before_init(tmp_path):
+    """Loading a checkpoint before init() must work locally (no broadcast),
+    e.g. to build params before bringing up the mesh."""
+    import horovod_tpu as hvd
+
+    path = str(tmp_path / "pre_init.msgpack")
+    params = {"w": jnp.arange(4.0), "b": jnp.zeros(2)}
+    hvd.init(devices=jax.devices())
+    assert save_checkpoint(path, params) is True
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+    target = {"w": jnp.zeros(4), "b": jnp.ones(2)}
+    restored = restore_checkpoint(path, target)
+    assert jnp.allclose(restored["w"], params["w"])
+    assert jnp.allclose(restored["b"], params["b"])

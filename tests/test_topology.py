@@ -1,0 +1,62 @@
+"""Multi-axis mesh topology tests (horovod_tpu.core.topology)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.core import topology as T
+
+
+def test_make_mesh_axis_order_and_sizes():
+    mesh = T.make_mesh(data=2, model=2, seq=2)
+    sizes = T.mesh_axis_sizes(mesh)
+    assert sizes[T.DATA_AXIS] == 2
+    assert sizes[T.MODEL_AXIS] == 2
+    assert sizes[T.SEQ_AXIS] == 2
+    assert sizes[T.PIPE_AXIS] == 1
+    # data outermost, model innermost
+    assert mesh.axis_names[0] == T.DATA_AXIS
+    assert mesh.axis_names[-1] == T.MODEL_AXIS
+
+
+def test_make_mesh_with_config_and_expert_axis():
+    cfg = T.ParallelConfig(data=2, expert=2, model=2)
+    mesh = T.make_mesh(cfg)
+    assert T.mesh_axis_sizes(mesh)[T.EXPERT_AXIS] == 2
+    # expert defaults to riding the data axis (no separate axis)
+    mesh2 = T.make_mesh(data=8)
+    assert T.EXPERT_AXIS not in mesh2.axis_names
+
+
+def test_make_mesh_device_count_mismatch():
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        T.make_mesh(data=4, model=4)
+
+
+def test_make_mesh_rejects_config_plus_kwargs():
+    with pytest.raises(TypeError):
+        T.make_mesh(T.ParallelConfig(data=8), model=2)
+
+
+def test_axis_helpers_inside_shard_map():
+    mesh = T.make_mesh(data=4, model=2)
+
+    def f(x):
+        return (x
+                + T.axis_size(T.MODEL_AXIS)
+                + T.axis_index(T.DATA_AXIS))[None]
+
+    out = jax.shard_map(
+        f, mesh=mesh, in_specs=P(),
+        out_specs=P((T.DATA_AXIS, T.PIPE_AXIS, T.SEQ_AXIS, T.MODEL_AXIS)),
+        check_vma=False)(jnp.zeros(()))
+    # data index contributes 0..3 twice (model axis size 2 everywhere)
+    assert sorted(int(v) for v in out) == [2, 2, 3, 3, 4, 4, 5, 5]
+
+
+def test_validate_mesh():
+    mesh = T.make_mesh(data=8)
+    with pytest.raises(ValueError, match="missing required"):
+        T.validate_mesh(mesh, (T.EXPERT_AXIS,))
+    T.validate_mesh(mesh, (T.DATA_AXIS, T.MODEL_AXIS))
