@@ -1,0 +1,56 @@
+"""horovod_tpu — a TPU-native distributed data-parallel training framework.
+
+Brand-new implementation of the capabilities of Horovod (reference:
+WeichenXu123/horovod v0.13.0), re-architected for TPU: ranks resolve from
+the JAX process/device mesh instead of MPI_COMM_WORLD, and the MPI/NCCL
+collectives become XLA collectives (psum / all_gather / ppermute) compiled
+over the pod's ICI/DCN fabric.  See SURVEY.md for the design blueprint and
+per-symbol reference citations in each module.
+
+Top-level API (≙ ``import horovod.tensorflow as hvd`` surface,
+reference horovod/tensorflow/__init__.py, horovod/torch/__init__.py):
+
+    import horovod_tpu as hvd
+    hvd.init()
+    hvd.size(), hvd.rank(), hvd.local_size(), hvd.local_rank()
+    hvd.allreduce(x, average=True), hvd.allgather(x), hvd.broadcast(x, 0)
+    h = hvd.allreduce_async(x); hvd.poll(h); hvd.synchronize(h)
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+"""
+
+from .core.state import (  # noqa: F401
+    REPLICA_AXIS,
+    NotInitializedError,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mesh,
+    mpi_threads_supported,
+    process_count,
+    process_index,
+    rank,
+    replica_id,
+    shutdown,
+    size,
+)
+from .ops.collective import (  # noqa: F401
+    HorovodError,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    broadcast,
+    broadcast_async,
+    poll,
+    shard,
+    synchronize,
+)
+from .parallel.data import (  # noqa: F401
+    DistributedOptimizer,
+    broadcast_global_variables,
+    broadcast_parameters,
+)
+
+__version__ = "0.1.0"

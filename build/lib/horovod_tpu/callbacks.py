@@ -1,0 +1,186 @@
+"""Training-loop callbacks — TPU-native port of horovod.keras.callbacks.
+
+Same four callbacks, same semantics (reference: horovod/keras/callbacks.py),
+bound to :class:`horovod_tpu.frontends.loop.Trainer` instead of a Keras
+model.  LR mutation goes through ``optax.inject_hyperparams`` state (no
+recompilation) instead of ``K.set_value``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import numpy as np
+
+from .core import state as _state
+from .parallel.data import broadcast_parameters
+
+
+class Callback:
+    def set_trainer(self, trainer) -> None:
+        self.trainer = trainer
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast parameters (and optimizer state) from root at train start
+    so every replica begins identical — required for fresh random inits and
+    for checkpoint restores (≙ keras/callbacks.py:8-34)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, logs=None) -> None:
+        self.trainer.params = broadcast_parameters(
+            self.trainer.params, root_rank=self.root_rank)
+        if getattr(self.trainer, "model_state", None) is not None:
+            self.trainer.model_state = broadcast_parameters(
+                self.trainer.model_state, root_rank=self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics across replicas at epoch end, in place, so
+    metric-driven callbacks (early stopping, LR plateau) see global values
+    (≙ keras/callbacks.py:37-87).  Metrics are reduced in sorted-name order
+    for cross-process determinism, as the reference does
+    (keras/callbacks.py:72-73)."""
+
+    def on_epoch_end(self, epoch: int, logs=None) -> None:
+        from .ops import collective as C
+
+        if not logs:
+            return
+        for metric in sorted(logs.keys()):
+            value = logs[metric]
+            if isinstance(value, (int, float, np.floating)):
+                logs[metric] = float(C.allreduce(
+                    np.asarray(value, np.float32), average=True,
+                    name=f"metric.{metric}"))
+
+
+class LearningRateScheduleCallback(Callback):
+    """Set ``lr = initial_lr * multiplier(epoch)`` between ``start_epoch``
+    and ``end_epoch`` (≙ keras/callbacks.py:90-199).
+
+    ``multiplier`` is a constant or ``f(epoch) -> factor``; with
+    ``staircase=False`` adjustment happens every batch with fractional
+    epochs ``epoch + batch/steps_per_epoch``.  ``momentum_correction``
+    rescales momentum by ``new_lr/old_lr`` for the duration of the batch
+    (Goyal et al., arXiv:1706.02677 — the same correction the reference
+    applies, keras/callbacks.py:161-165).
+    """
+
+    def __init__(self, multiplier: Union[float, Callable[[float], float]],
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr: Optional[float] = None
+        self.restore_momentum: Optional[float] = None
+        self.current_epoch: int = 0
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _adjust_learning_rate(self, epoch: float) -> None:
+        old_lr = self.trainer.lr
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        self.trainer.lr = new_lr
+        if self.momentum_correction and self.trainer.momentum is not None \
+                and old_lr > 0:
+            self.restore_momentum = self.trainer.momentum
+            self.trainer.momentum = self.restore_momentum * new_lr / old_lr
+
+    def _restore_momentum_if_needed(self) -> None:
+        if self.restore_momentum is not None:
+            self.trainer.momentum = self.restore_momentum
+            self.restore_momentum = None
+
+    def on_train_begin(self, logs=None) -> None:
+        self.initial_lr = self.trainer.lr
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self.trainer.steps_per_epoch
+            if not self.steps_per_epoch:
+                raise ValueError(
+                    "steps_per_epoch is required for smooth (staircase="
+                    "False) schedules.")
+
+    def on_epoch_begin(self, epoch: int, logs=None) -> None:
+        self.current_epoch = epoch
+
+    def on_batch_begin(self, batch: int, logs=None) -> None:
+        if (self.current_epoch < self.start_epoch or
+                (self.end_epoch is not None
+                 and self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            self._adjust_learning_rate(self.current_epoch)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch)
+
+    def on_batch_end(self, batch: int, logs=None) -> None:
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch: int, logs=None) -> None:
+        if logs is not None:
+            logs["lr"] = self.trainer.lr
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup ``lr/size → lr`` over ``warmup_epochs``
+    (Goyal et al.; ≙ keras/callbacks.py:202-259, same multiplier formula:
+    ``1/size * (epoch * (size-1)/warmup + 1)``)."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch):
+            size = _state.size()
+            # Nudge so epoch boundaries land on round values
+            # (≙ keras/callbacks.py:243-247).
+            epoch += 1.0 / self.steps_per_epoch
+            return 1.0 / size * (epoch * (size - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch: int, logs=None) -> None:
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0:
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self.trainer.lr:g}.")
+
+
+def warmup_then_decay_schedule(base_lr: float, warmup_epochs: int,
+                               steps_per_epoch: int,
+                               decay_epochs_and_factors=None):
+    """The same warmup math as an *optax schedule* — the fully-static
+    alternative for jit-everything training (no callback machinery,
+    compiler sees the whole schedule)."""
+    import optax
+
+    size = _state.size()
+    warmup_steps = warmup_epochs * steps_per_epoch
+    # Segments: [warmup ramp][base_lr until first decay][decay segments...]
+    # with len(boundaries) == len(schedules) - 1.
+    schedules = [
+        optax.linear_schedule(init_value=base_lr / size, end_value=base_lr,
+                              transition_steps=warmup_steps),
+        optax.constant_schedule(base_lr),
+    ]
+    boundaries = [warmup_steps]
+    for epoch, factor in (decay_epochs_and_factors or []):
+        schedules.append(optax.constant_schedule(base_lr * factor))
+        boundaries.append(epoch * steps_per_epoch)
+    return optax.join_schedules(schedules, boundaries)

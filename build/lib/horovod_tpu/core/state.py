@@ -1,0 +1,278 @@
+"""Global runtime state for horovod_tpu.
+
+TPU-native re-design of the reference's ``HorovodGlobalState`` singleton
+(reference: horovod/common/operations.cc:107-200).  The reference keeps a
+background thread, a mutex-guarded tensor table and MPI rank/size caches;
+under JAX's single-controller SPMD model most of that machinery dissolves:
+
+* Process bootstrap: ``jax.distributed`` + the process/device enumeration
+  replaces ``MPI_Init_thread`` / ``MPI_COMM_WORLD``
+  (reference: operations.cc:1173-1196).
+* The device mesh (one logical axis, ``"hvd"``) replaces the flat
+  ``MPI_COMM_WORLD`` rank space.  Collectives become XLA collectives over
+  that axis, scheduled by the compiler instead of a 5 ms background tick
+  (reference: operations.cc:1219-1221).
+
+Topology model
+--------------
+The reference binds exactly one GPU to one MPI process, so "rank" is both a
+process id and a device id.  On TPU one process typically controls several
+chips, so the two concepts split:
+
+* **replica** — one TPU device.  ``size()`` counts replicas globally;
+  this is the axis gradients are averaged over.
+* **process** — one controller host process (``jax.process_index()``).
+
+``rank()``/``local_rank()`` keep Horovod's semantics at the host level: they
+return the first replica owned by the calling process, which equals the
+Horovod rank exactly in the one-device-per-process deployment the reference
+assumes.  Inside traced per-replica code the true replica id is
+``replica_id()`` (= ``lax.axis_index("hvd")``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+
+# Name of the one-dimensional mesh axis all Horovod-style collectives run
+# over.  Mirrors the single flat rank space of MPI_COMM_WORLD.
+REPLICA_AXIS = "hvd"
+
+
+class NotInitializedError(RuntimeError):
+    """Raised when the library is used before ``init()``.
+
+    Mirrors the reference's per-call ``CheckInitialized`` /
+    "Horovod has not been initialized; use hvd.init()." errors
+    (reference: horovod/common/operations.cc:210-220 analogue in
+    common/__init__.py:54-58).
+    """
+
+    def __init__(self) -> None:
+        super().__init__(
+            "horovod_tpu has not been initialized; use horovod_tpu.init()."
+        )
+
+
+@dataclass
+class _GlobalState:
+    """Mutable singleton state guarded by ``lock`` (coarse, like the
+    reference's single global mutex — operations.cc:113)."""
+
+    initialized: bool = False
+    shutdown: bool = False
+    # The 1-D replica mesh over every addressable device.
+    mesh: Optional[jax.sharding.Mesh] = None
+    # Devices in mesh order (process-major, then local ordinal).
+    devices: tuple = ()
+    # Cached topology numbers.
+    size: int = 0
+    local_size: int = 0
+    process_index: int = 0
+    process_count: int = 1
+    # Tensor-fusion threshold in bytes (reference default 64 MB,
+    # operations.cc:140, env HOROVOD_FUSION_THRESHOLD).
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    # Timeline (utils.timeline.Timeline) when HOROVOD_TIMELINE is set.
+    timeline: Any = None
+    # Native coordinator handle (ops.coordinator.Coordinator).
+    coordinator: Any = None
+    # Handle manager for the async API (ops.handles.HandleManager).
+    handle_manager: Any = None
+    # Background drain thread for async eager ops (≙ the reference's
+    # background coordinator thread, operations.cc:1167).
+    bg_thread: Any = None
+    bg_stop: Any = None
+    lock: threading.RLock = field(default_factory=threading.RLock)
+
+
+_state = _GlobalState()
+
+
+def global_state() -> _GlobalState:
+    return _state
+
+
+def _build_mesh(devices) -> jax.sharding.Mesh:
+    import numpy as np
+
+    return jax.sharding.Mesh(np.asarray(devices), (REPLICA_AXIS,))
+
+
+def init(devices=None) -> None:
+    """Initialize horovod_tpu.
+
+    TPU-native equivalent of ``hvd.init()`` → ``horovod_init`` →
+    ``InitializeHorovodOnce`` (reference: horovod/common/__init__.py:50-53,
+    operations.cc:1479-1490).  Instead of spawning a background MPI thread,
+    we enumerate the JAX process/device topology and build the replica mesh.
+    Safe to call more than once (the reference's init is also idempotent via
+    an atomic flag — operations.cc:1481).
+
+    Args:
+      devices: optional explicit device list (defaults to ``jax.devices()``
+        in process-major order).  Used by tests to restrict the replica set.
+    """
+    if _state.initialized:
+        if devices is None:
+            return
+        # Re-init with a different replica set: tear down the old runtime
+        # (background thread, coordinator, timeline) first.
+        shutdown()
+    with _state.lock:
+        devs = tuple(devices if devices is not None else jax.devices())
+        _state.devices = devs
+        _state.mesh = _build_mesh(devs)
+        _state.size = len(devs)
+        _state.process_index = jax.process_index()
+        _state.process_count = jax.process_count()
+        if devices is not None:
+            local = [d for d in devs if d.process_index == _state.process_index]
+            _state.local_size = len(local) if local else len(devs)
+        else:
+            _state.local_size = jax.local_device_count()
+        _state.fusion_threshold_bytes = int(
+            os.environ.get("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024)
+        )
+        _state.shutdown = False
+        _state.initialized = True
+
+        # Timeline: rank-0-only Chrome tracing, same env contract as the
+        # reference (operations.cc:1201-1204).
+        timeline_path = os.environ.get("HOROVOD_TIMELINE")
+        if timeline_path and _state.process_index == 0:
+            from ..utils.timeline import Timeline
+
+            _state.timeline = Timeline(timeline_path)
+        else:
+            _state.timeline = None
+
+        from ..ops.handles import HandleManager
+
+        _state.handle_manager = HandleManager()
+
+        from ..ops.coordinator import Coordinator
+
+        _state.coordinator = Coordinator(
+            size=_state.size,
+            fusion_threshold=_state.fusion_threshold_bytes,
+            timeline=_state.timeline,
+        )
+
+        # Spawn the background tick thread serving async eager collectives
+        # (≙ InitializeHorovodOnce spawning BackgroundThreadLoop,
+        # operations.cc:1481-1483).
+        from ..ops import collective as _collective
+
+        _state.bg_stop = threading.Event()
+        _state.bg_thread = threading.Thread(
+            target=_collective._background_loop, args=(_state.bg_stop,),
+            name="horovod_tpu-tick", daemon=True)
+        _state.bg_thread.start()
+
+
+def shutdown() -> None:
+    """Cooperative shutdown: flush the timeline, drop the coordinator.
+
+    Mirrors the reference's shutdown broadcast + callback flush with
+    SHUT_DOWN_ERROR (operations.cc:1377-1442, :1456-1474) — under SPMD there
+    are no in-flight negotiated tensors to poison, so this reduces to
+    releasing state; pending async handles stay valid (XLA owns them).
+    """
+    if _state.bg_stop is not None:
+        _state.bg_stop.set()
+        if _state.bg_thread is not None:
+            _state.bg_thread.join(timeout=2.0)
+    with _state.lock:
+        _state.bg_thread = None
+        _state.bg_stop = None
+        if _state.timeline is not None:
+            _state.timeline.close()
+            _state.timeline = None
+        if _state.coordinator is not None:
+            _state.coordinator.close()
+            _state.coordinator = None
+        _state.shutdown = True
+        _state.initialized = False
+
+
+def _check_initialized() -> None:
+    if not _state.initialized:
+        raise NotInitializedError()
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def size() -> int:
+    """Global replica (device) count — the gradient-averaging denominator.
+
+    Reference: ``horovod_size`` (operations.cc:1511-1515) returns the
+    MPI_COMM_WORLD size; here the replica mesh extent plays that role.
+    """
+    _check_initialized()
+    return _state.size
+
+
+def local_size() -> int:
+    """Replicas owned by this process (reference: horovod_local_size,
+    operations.cc:1523-1527, via MPI_Comm_split_type(SHARED))."""
+    _check_initialized()
+    return _state.local_size
+
+
+def rank() -> int:
+    """Host-level rank: first replica owned by this process.
+
+    Equals the Horovod rank exactly in one-device-per-process mode
+    (reference: horovod_rank, operations.cc:1505-1509).  Per-replica code
+    should use ``replica_id()`` instead.
+    """
+    _check_initialized()
+    return _state.process_index * _state.local_size
+
+
+def local_rank() -> int:
+    """Host-level local rank (reference: horovod_local_rank,
+    operations.cc:1517-1521).  0 for the controller process."""
+    _check_initialized()
+    return 0
+
+
+def process_index() -> int:
+    _check_initialized()
+    return _state.process_index
+
+
+def process_count() -> int:
+    _check_initialized()
+    return _state.process_count
+
+
+def mpi_threads_supported() -> bool:
+    """API-parity shim.  There is no MPI; multi-threaded host dispatch into
+    XLA is always safe, so report True (reference:
+    horovod_mpi_threads_supported, operations.cc:1531-1539)."""
+    _check_initialized()
+    return True
+
+
+def mesh() -> jax.sharding.Mesh:
+    """The global 1-D replica mesh (axis ``"hvd"``)."""
+    _check_initialized()
+    return _state.mesh
+
+
+def replica_id():
+    """The current replica's id inside traced per-replica code.
+
+    Only valid under ``shard_map``/``pmap`` style tracing over the replica
+    axis; this is the true analogue of the reference's per-process rank.
+    """
+    return jax.lax.axis_index(REPLICA_AXIS)

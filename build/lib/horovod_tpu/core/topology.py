@@ -1,0 +1,137 @@
+"""Multi-axis device-mesh topology for hybrid parallelism.
+
+The reference's rank space is flat — one MPI_COMM_WORLD axis, because data
+parallelism is its only strategy (SURVEY.md §2.3; reference
+horovod/common/operations.cc:1176-1196).  A TPU pod is not flat: chips form
+a torus of ICI links, and XLA shards programs over an N-dimensional
+``jax.sharding.Mesh`` whose named axes map onto that torus.  This module
+owns the axis vocabulary and mesh construction for every parallelism
+strategy the framework offers beyond the reference's DP:
+
+====== ============================ ======================================
+axis   strategy                     what is sharded over it
+====== ============================ ======================================
+data   data parallel (DP)           batch; gradients psum over it
+model  tensor parallel (TP)         weight matrices (heads / hidden dim)
+seq    sequence/context par. (SP)   the sequence axis (ring attention)
+pipe   pipeline parallel (PP)       transformer layer blocks
+expert expert parallel (EP)         MoE experts (all_to_all routing)
+====== ============================ ======================================
+
+Axis ordering puts ``data`` outermost (it tolerates the slowest links —
+gradient psum once per step, so it can ride DCN across slices) and
+``model`` innermost (activations move every layer, so it must sit on the
+fastest ICI neighbors).  This is the standard mapping from the public
+scaling playbooks; XLA then lowers each collective onto the matching
+links.
+
+Expert parallelism conventionally *reuses* the data axis (experts sharded
+over DP groups, tokens routed with all_to_all inside them), so ``expert``
+only becomes its own mesh axis when explicitly requested.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+# Canonical axis names.  ``REPLICA_AXIS`` ("hvd") from core.state is the
+# degenerate 1-D case used by the Horovod-parity API.
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+# Outermost → innermost mesh order (slowest → fastest links).
+_AXIS_ORDER = (DATA_AXIS, PIPE_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Degrees of each parallelism strategy.
+
+    Any degree may be 1 (strategy disabled).  The product of all degrees
+    must equal the number of devices the mesh is built over.  ``expert``
+    defaults to 0 = "ride the data axis" (the conventional EP placement);
+    set it >0 for a dedicated expert mesh axis.
+    """
+
+    data: int = 1
+    model: int = 1
+    seq: int = 1
+    pipe: int = 1
+    expert: int = 0
+
+    @property
+    def device_count(self) -> int:
+        n = self.data * self.model * self.seq * self.pipe
+        return n * (self.expert if self.expert > 0 else 1)
+
+    def axis_sizes(self) -> dict:
+        sizes = {DATA_AXIS: self.data, PIPE_AXIS: self.pipe,
+                 SEQ_AXIS: self.seq, MODEL_AXIS: self.model}
+        if self.expert > 0:
+            sizes[EXPERT_AXIS] = self.expert
+        return sizes
+
+
+def make_mesh(config: Optional[ParallelConfig] = None,
+              devices: Optional[Sequence] = None,
+              **degrees) -> jax.sharding.Mesh:
+    """Build the multi-axis device mesh for a parallel configuration.
+
+    Either pass a :class:`ParallelConfig` or axis degrees as keywords::
+
+        mesh = make_mesh(data=2, model=2, seq=2)   # 8 devices
+
+    Axes with degree 1 are still present in the mesh (size-1 axes are free)
+    so the same model code works at any configuration.  Devices default to
+    ``jax.devices()``; their count must equal the product of the degrees.
+    """
+    if config is None:
+        config = ParallelConfig(**degrees)
+    elif degrees:
+        raise TypeError("pass either a ParallelConfig or keyword degrees, "
+                        "not both")
+    devs = list(devices if devices is not None else jax.devices())
+    if config.device_count != len(devs):
+        raise ValueError(
+            f"parallel config {config} needs {config.device_count} devices "
+            f"but {len(devs)} were provided")
+    sizes = config.axis_sizes()
+    names = tuple(a for a in _AXIS_ORDER if a in sizes)
+    shape = tuple(sizes[a] for a in names)
+    arr = np.asarray(devs).reshape(shape)
+    return jax.sharding.Mesh(arr, names)
+
+
+def axis_size(axis: str) -> int:
+    """Extent of ``axis`` inside traced code (static under shard_map)."""
+    return jax.lax.axis_size(axis)
+
+
+def axis_index(axis: str):
+    """This shard's coordinate along ``axis`` inside traced code."""
+    return jax.lax.axis_index(axis)
+
+
+def mesh_axis_sizes(mesh: jax.sharding.Mesh) -> dict:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def validate_mesh(mesh: jax.sharding.Mesh,
+                  required_axes: Tuple[str, ...]) -> None:
+    """Raise with a clear message when a strategy is used on a mesh that
+    lacks its axis (the analogue of the reference coordinator's explicit
+    mismatch errors, operations.cc:255-461 — fail loudly, not with a
+    compiler backtrace)."""
+    missing = [a for a in required_axes if a not in mesh.axis_names]
+    if missing:
+        raise ValueError(
+            f"mesh with axes {mesh.axis_names} is missing required "
+            f"axes {missing}; build it with horovod_tpu.core.topology."
+            f"make_mesh(...)")

@@ -1,0 +1,146 @@
+"""Keras-style training loop for JAX — host for the Horovod callbacks.
+
+The reference's L5 glue assumes a Keras/Estimator loop exists to hang
+callbacks on (horovod/keras/callbacks.py); JAX has no such loop, so this
+module provides a minimal one with the same callback protocol
+(`on_train_begin`, `on_epoch_begin/end`, `on_batch_begin/end`) while the
+step itself stays a single compiled SPMD program from
+:mod:`..parallel.training`.
+
+Learning rate and momentum are *runtime-settable without recompilation*:
+the optimizer is wrapped in ``optax.inject_hyperparams`` so the callbacks'
+per-batch LR adjustments (warmup/schedule with momentum correction,
+≙ keras/callbacks.py:90-259) mutate optimizer state, not the compiled
+graph — the TPU-friendly translation of Keras' ``K.set_value`` on
+optimizer variables.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core import state as _state
+from ..parallel.training import (make_train_step, make_train_step_with_state,
+                                 shard_batch)
+
+
+class Trainer:
+    """Minimal distributed training loop.
+
+    Args:
+      loss_fn: ``loss_fn(params, batch) -> scalar`` or, with
+        ``model_state``, ``loss_fn(params, model_state, batch) ->
+        (scalar, new_model_state)``.
+      params: initial parameter pytree.
+      optimizer_fn: optax optimizer factory, e.g. ``optax.sgd``; called as
+        ``optimizer_fn(learning_rate=lr, **optimizer_kwargs)`` under
+        ``inject_hyperparams``.
+      lr: initial learning rate (``initial_lr`` in callback terms).
+      callbacks: list of callback objects (see :mod:`horovod_tpu.callbacks`).
+      model_state: optional non-trained model state (e.g. BatchNorm stats).
+    """
+
+    def __init__(self, loss_fn, params, optimizer_fn=optax.sgd,
+                 lr: float = 0.01, optimizer_kwargs: Optional[dict] = None,
+                 callbacks: Optional[Sequence] = None, model_state=None,
+                 average_gradients: bool = True,
+                 fusion_threshold: Optional[int] = None):
+        _state._check_initialized()
+        self.params = params
+        self.model_state = model_state
+        self._has_state = model_state is not None
+        kwargs = dict(optimizer_kwargs or {})
+        self._momentum_key = "momentum" if "momentum" in kwargs else None
+        self.optimizer = optax.inject_hyperparams(optimizer_fn)(
+            learning_rate=lr, **kwargs)
+        self.opt_state = self.optimizer.init(params)
+        if self._has_state:
+            self._step = make_train_step_with_state(
+                loss_fn, self.optimizer, average=average_gradients,
+                fusion_threshold=fusion_threshold, donate=False)
+        else:
+            self._step = make_train_step(
+                loss_fn, self.optimizer, average=average_gradients,
+                fusion_threshold=fusion_threshold, donate=False)
+        self.callbacks = list(callbacks or [])
+        for cb in self.callbacks:
+            if hasattr(cb, "set_trainer"):
+                cb.set_trainer(self)
+        self.history: List[dict] = []
+        self.steps_per_epoch: Optional[int] = None
+        self.stop_training = False
+
+    # -- hyperparameter access for callbacks (≙ K.get/set_value on
+    #    optimizer.lr / optimizer.momentum) ------------------------------
+    @property
+    def lr(self) -> float:
+        return float(self.opt_state.hyperparams["learning_rate"])
+
+    @lr.setter
+    def lr(self, value: float) -> None:
+        self.opt_state.hyperparams["learning_rate"] = jnp.asarray(
+            value, jnp.float32)
+
+    @property
+    def momentum(self) -> Optional[float]:
+        if self._momentum_key is None:
+            return None
+        return float(self.opt_state.hyperparams[self._momentum_key])
+
+    @momentum.setter
+    def momentum(self, value: float) -> None:
+        if self._momentum_key is None:
+            raise AttributeError("optimizer has no momentum hyperparameter")
+        self.opt_state.hyperparams[self._momentum_key] = jnp.asarray(
+            value, jnp.float32)
+
+    @property
+    def size(self) -> int:
+        return _state.size()
+
+    # -- loop -------------------------------------------------------------
+    def _call(self, hook: str, *args) -> None:
+        for cb in self.callbacks:
+            fn = getattr(cb, hook, None)
+            if fn is not None:
+                fn(*args)
+
+    def fit(self, batches: Callable[[int, int], Any], epochs: int,
+            steps_per_epoch: int, initial_epoch: int = 0) -> List[dict]:
+        """Run the loop.  ``batches(epoch, step)`` returns one global batch
+        (leading axis divisible by the replica count).
+
+        ``initial_epoch`` resumes epoch numbering after a checkpoint
+        restore so epoch-indexed callbacks (warmup, schedules) continue
+        where they left off — the reference example passes the broadcast
+        ``resume_from_epoch`` to Keras ``fit`` the same way
+        (examples/keras_imagenet_resnet50.py:130-133)."""
+        self.steps_per_epoch = steps_per_epoch
+        self._call("on_train_begin", None)
+        for epoch in range(initial_epoch, epochs):
+            if self.stop_training:
+                break
+            self._call("on_epoch_begin", epoch, None)
+            losses = []
+            for step in range(steps_per_epoch):
+                self._call("on_batch_begin", step, None)
+                batch = shard_batch(batches(epoch, step))
+                if self._has_state:
+                    (self.params, self.model_state, self.opt_state,
+                     loss) = self._step(self.params, self.model_state,
+                                        self.opt_state, batch)
+                else:
+                    self.params, self.opt_state, loss = self._step(
+                        self.params, self.opt_state, batch)
+                losses.append(loss)
+                self._call("on_batch_end", step, None)
+            logs = {"loss": float(np.mean([float(l) for l in losses]))}
+            self._call("on_epoch_end", epoch, logs)
+            self.history.append(logs)
+        self._call("on_train_end", None)
+        return self.history

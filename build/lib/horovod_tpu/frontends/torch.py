@@ -1,0 +1,263 @@
+"""PyTorch frontend: the reference's ``horovod.torch`` API over the TPU
+runtime.
+
+Re-creation of the reference Torch surface (horovod/torch/mpi_ops.py:58-344,
+horovod/torch/__init__.py) with the MPI/cffi plumbing replaced by the
+eager collective path of :mod:`..ops.collective`: torch CPU tensors bridge
+through NumPy (zero-copy where torch allows it), collectives execute as
+compiled XLA programs over the replica mesh, and the async handle API maps
+onto the runtime's HandleManager exactly like the reference's
+``horovod_torch_poll`` / ``wait_and_clear`` (torch/mpi_ops.cc:322-332).
+
+Usage parity::
+
+    import horovod_tpu.frontends.torch as hvd
+    hvd.init()
+    h = hvd.allreduce_async_(p.grad, name="g0")   # in-place, async
+    hvd.synchronize(h)
+    opt = hvd.DistributedOptimizer(opt, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+Notes vs the reference:
+
+* In-place variants write the result back into the caller's tensor in
+  ``synchronize`` (the reference's C++ adapter resizes/fills the output
+  TH tensor, torch/adapter.cc:109-120).
+* float64 tensors compute in float32 on TPU (x64 is disabled) and cast
+  back — dtype is preserved at the API boundary.
+* ``DistributedOptimizer`` registers post-accumulate-grad hooks that fire
+  ``allreduce_async_`` during backward and synchronizes them in
+  ``step()`` — the reference's exact flow (torch/__init__.py:62-87).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+import torch
+
+from ..core import state as _state
+from ..core.state import (init, is_initialized, local_rank, local_size,  # noqa: F401
+                          mpi_threads_supported, rank, shutdown, size)
+from ..ops import collective as _C
+
+# handle -> (target tensor for in-place write-back or None, torch dtype)
+_inplace_targets: Dict[int, Tuple[Optional[torch.Tensor], torch.dtype]] = {}
+
+
+def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
+    if not isinstance(tensor, torch.Tensor):
+        raise ValueError(f"expected a torch.Tensor, got {type(tensor)}")
+    if tensor.device.type != "cpu":
+        raise ValueError(
+            "horovod_tpu.frontends.torch bridges CPU tensors; move the "
+            "tensor to CPU first (TPU-resident training should use the "
+            "JAX surface)")
+    t = tensor.detach()
+    if not t.is_contiguous():
+        # Same contract as the reference (torch/mpi_ops.py:41-42) but we
+        # make it contiguous instead of raising.
+        t = t.contiguous()
+    return t.numpy()
+
+
+def _from_numpy(arr, dtype: torch.dtype) -> torch.Tensor:
+    return torch.from_numpy(np.ascontiguousarray(arr)).to(dtype)
+
+
+def _enqueue(op: str, tensor: torch.Tensor, *, inplace: bool,
+             name: Optional[str], **kw) -> int:
+    arr = _to_numpy(tensor)
+    fn = getattr(_C, f"{op}_async")
+    handle = fn(arr, name=name, **kw)
+    _inplace_targets[handle] = (tensor if inplace else None, tensor.dtype)
+    return handle
+
+
+def poll(handle: int) -> bool:
+    """Non-blocking completion check (≙ horovod_torch_poll,
+    torch/mpi_ops.py:318-325)."""
+    return _C.poll(handle)
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Block until ``handle`` completes; returns the result tensor (and
+    copies it into the original for in-place ops) —
+    ≙ torch/mpi_ops.py:328-344."""
+    result = np.asarray(_C.synchronize(handle))
+    target, dtype = _inplace_targets.pop(handle, (None, None))
+    if dtype is None:
+        dtype = torch.from_numpy(result).dtype
+    out = _from_numpy(result, dtype)
+    if target is not None:
+        if target.shape != out.shape:
+            target.resize_(out.shape)
+        target.copy_(out)
+        return target
+    return out
+
+
+# -- allreduce --------------------------------------------------------------
+
+def allreduce_async(tensor, average: bool = True,
+                    name: Optional[str] = None) -> int:
+    return _enqueue("allreduce", tensor, inplace=False, name=name,
+                    average=average)
+
+
+def allreduce_async_(tensor, average: bool = True,
+                     name: Optional[str] = None) -> int:
+    return _enqueue("allreduce", tensor, inplace=True, name=name,
+                    average=average)
+
+
+def allreduce(tensor, average: bool = True,
+              name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(allreduce_async(tensor, average, name))
+
+
+def allreduce_(tensor, average: bool = True,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average, name))
+
+
+# -- allgather --------------------------------------------------------------
+
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    return _enqueue("allgather", tensor, inplace=False, name=name)
+
+
+def allgather(tensor, name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(allgather_async(tensor, name))
+
+
+# -- broadcast --------------------------------------------------------------
+
+def broadcast_async(tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    return _enqueue("broadcast", tensor, inplace=False, name=name,
+                    root_rank=root_rank)
+
+
+def broadcast_async_(tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    return _enqueue("broadcast", tensor, inplace=True, name=name,
+                    root_rank=root_rank)
+
+
+def broadcast(tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_(tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+# -- high-level glue --------------------------------------------------------
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Sync a ``state_dict`` or iterable of ``(name, tensor)`` from
+    ``root_rank`` — launch all broadcasts async, then synchronize
+    (≙ torch/__init__.py:125-152)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if not torch.is_tensor(p):
+            continue
+        if not torch.is_floating_point(p) and p.dtype not in (
+                torch.int32, torch.int64, torch.uint8, torch.int8,
+                torch.int16, torch.bool):
+            continue
+        t = p.data if isinstance(p, torch.nn.Parameter) else p
+        handles.append(broadcast_async_(t, root_rank,
+                                        name=f"broadcast.{name}"))
+    for h in handles:
+        synchronize(h)
+
+
+class _DistributedOptimizer:
+    """Wraps a torch optimizer: per-parameter hooks fire async allreduce
+    during backward; ``step`` synchronizes then delegates
+    (≙ torch/__init__.py:30-122).  A plain wrapper rather than the
+    reference's dynamic subclass — the full Optimizer surface is delegated
+    through ``__getattr__``."""
+
+    def __init__(self, optimizer: torch.optim.Optimizer,
+                 named_parameters: Optional[Iterable] = None,
+                 average: bool = True):
+        self._inner = optimizer
+        self._average = average
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [(f"allreduce.noname.{i}.{j}", p)
+                     for i, group in enumerate(optimizer.param_groups)
+                     for j, p in enumerate(group["params"])]
+        self._param_names = {p: name for name, p in named}
+        self._handles: Dict[torch.Tensor, int] = {}
+        self._hook_handles = []
+        self._register_hooks()
+
+    # Delegate the Optimizer surface to the wrapped instance.
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner"], item)
+
+    @property
+    def param_groups(self):
+        return self._inner.param_groups
+
+    @property
+    def state(self):
+        return self._inner.state
+
+    def _register_hooks(self) -> None:
+        for group in self._inner.param_groups:
+            for p in group["params"]:
+                if not p.requires_grad:
+                    continue
+                self._hook_handles.append(
+                    p.register_post_accumulate_grad_hook(self._make_hook()))
+
+    def _make_hook(self):
+        def hook(p: torch.Tensor) -> None:
+            if p.grad is None:
+                return
+            name = self._param_names.get(
+                p, f"allreduce.noname.{id(p)}")
+            self._handles[p] = allreduce_async_(
+                p.grad, average=self._average, name=f"grad.{name}")
+
+        return hook
+
+    def synchronize(self) -> None:
+        for p, handle in list(self._handles.items()):
+            synchronize(handle)
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return self._inner.step(closure)
+
+    def zero_grad(self, set_to_none: bool = True):
+        return self._inner.zero_grad(set_to_none=set_to_none)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._inner.load_state_dict(sd)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters: Optional[Iterable] = None,
+                         average: bool = True) -> _DistributedOptimizer:
+    """Distributed wrapper for any ``torch.optim.Optimizer``
+    (≙ hvd.DistributedOptimizer, torch/__init__.py:90-122)."""
+    return _DistributedOptimizer(optimizer, named_parameters, average)

@@ -1,0 +1,88 @@
+"""MNIST models — the reference's example workload family.
+
+The reference trains a small ConvNet on MNIST in every frontend
+(examples/tensorflow_mnist.py:32-60, examples/pytorch_mnist.py:54-70,
+examples/keras_mnist.py:37-48); these are the TPU-native equivalents in
+flax.  Architecture follows the reference examples' shape (two conv blocks
+then two dense layers) but is laid out TPU-first: NHWC, bfloat16 compute
+with float32 parameters, feature sizes padded to MXU-friendly multiples.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MnistCNN(nn.Module):
+    """ConvNet ≙ the reference examples' conv(32)-conv(64)-fc(512)-fc(10)
+    (examples/tensorflow_mnist.py:32-60).  Compute dtype bfloat16 keeps the
+    MXU busy; params stay float32 for stable SGD."""
+
+    num_classes: int = 10
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [B, 28, 28, 1] float32 in [0, 1]
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.compute_dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.compute_dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512, dtype=self.compute_dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class MnistMLP(nn.Module):
+    """Small dense net (≙ examples/keras_mnist.py's simpler topologies);
+    handy for fast tests."""
+
+    num_classes: int = 10
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.Dense(self.num_classes)(x)
+        return x
+
+
+def cross_entropy_loss(logits, labels):
+    """Mean softmax cross-entropy over the (local) batch."""
+    logp = jax.nn.log_softmax(logits)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1])
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def accuracy(logits, labels):
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+
+
+def synthetic_mnist(num: int, seed: int = 0):
+    """Deterministic synthetic MNIST-shaped data (the container has no
+    dataset egress; the reference CI likewise shrinks MNIST to a smoke run,
+    .travis.yml:105-109).  Labels are a fixed function of the images so a
+    model can actually fit them."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    images = rng.rand(num, 28, 28, 1).astype("float32")
+    # Label = argmax of mean intensity over 10 fixed random masks: learnable
+    # but non-trivial.
+    masks = rng.rand(10, 28 * 28).astype("float32")
+    flat = images.reshape(num, -1)
+    labels = np.argmax(flat @ masks.T, axis=1).astype("int32")
+    return images, labels
+
+
+def init_params(model: nn.Module, batch_size: int = 8, seed: int = 0):
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((batch_size, 28, 28, 1), jnp.float32)
+    return model.init(rng, dummy)["params"]

@@ -1,0 +1,76 @@
+"""Skip-gram word2vec — the reference's sparse-gradient example workload
+(examples/tensorflow_word2vec.py): embedding + NCE-style loss whose
+embedding gradients are sparse rows, exchanged via the allgather-based
+sparse allreduce (tensorflow/__init__.py:67-78).
+
+TPU-first layout: embedding dim a multiple of 128 by default so lookups and
+the NCE matmul tile onto the MXU; negative sampling via a fixed-size random
+draw (static shapes for XLA).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Word2VecParams(NamedTuple):
+    embeddings: jax.Array   # [vocab, dim]
+    nce_weights: jax.Array  # [vocab, dim]
+    nce_biases: jax.Array   # [vocab]
+
+
+def init_params(vocab_size: int, dim: int = 128, seed: int = 0
+                ) -> Word2VecParams:
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    # Uniform [-1, 1] embeddings, truncated-normal NCE weights — the
+    # standard word2vec init (≙ examples/tensorflow_word2vec.py:137-148).
+    emb = jax.random.uniform(k1, (vocab_size, dim), jnp.float32, -1.0, 1.0)
+    nce_w = jax.random.truncated_normal(
+        k2, -2.0, 2.0, (vocab_size, dim), jnp.float32) / np.sqrt(dim)
+    return Word2VecParams(emb, nce_w, jnp.zeros((vocab_size,), jnp.float32))
+
+
+def nce_loss(params: Word2VecParams, centers: jax.Array,
+             targets: jax.Array, neg_samples: jax.Array) -> jax.Array:
+    """Sampled-softmax / NCE objective over one skip-gram batch.
+
+    centers: [B] int32 — input word ids
+    targets: [B] int32 — context word ids (positives)
+    neg_samples: [K] int32 — shared negative draw
+    """
+    h = params.embeddings[centers]                      # [B, D]
+    pos_w = params.nce_weights[targets]                 # [B, D]
+    pos_b = params.nce_biases[targets]                  # [B]
+    pos_logit = jnp.sum(h * pos_w, axis=-1) + pos_b     # [B]
+    neg_w = params.nce_weights[neg_samples]             # [K, D]
+    neg_b = params.nce_biases[neg_samples]              # [K]
+    neg_logit = h @ neg_w.T + neg_b[None, :]            # [B, K]
+    pos_loss = jax.nn.softplus(-pos_logit)
+    neg_loss = jnp.sum(jax.nn.softplus(neg_logit), axis=-1)
+    return jnp.mean(pos_loss + neg_loss)
+
+
+def skipgram_batch(rng: np.random.RandomState, corpus: np.ndarray,
+                   batch_size: int, window: int = 2
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Sample (center, context) pairs from a token array."""
+    idx = rng.randint(window, len(corpus) - window, size=batch_size)
+    offs = rng.randint(1, window + 1, size=batch_size)
+    sign = rng.choice([-1, 1], size=batch_size)
+    centers = corpus[idx]
+    targets = corpus[idx + sign * offs]
+    return centers.astype("int32"), targets.astype("int32")
+
+
+def synthetic_corpus(vocab_size: int, length: int, seed: int = 0
+                     ) -> np.ndarray:
+    """Zipf-distributed token stream (word frequencies are Zipfian, which
+    is what makes the sparse path worthwhile)."""
+    rng = np.random.RandomState(seed)
+    ranks = np.arange(1, vocab_size + 1, dtype="float64")
+    probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    return rng.choice(vocab_size, size=length, p=probs).astype("int32")

@@ -1,0 +1,381 @@
+// Native coordinator: negotiation, validation, fusion planning, stall watch.
+//
+// C++ twin of horovod_tpu/ops/coordinator.py (the executable spec), itself
+// the TPU-native re-design of the reference coordinator inside
+// BackgroundThreadLoop (horovod/common/operations.cc:222-461, :1072-1115,
+// :1328-1374). The reference keeps this machinery in C++ because it sits on
+// the latency floor of every collective; ours does the same for the dynamic
+// (eager) path while the static pjit path bypasses it entirely.
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "wire.h"
+
+namespace hvdtpu {
+namespace {
+
+double MonotonicSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string ShapeStr(const std::vector<int64_t>& s) {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (i) os << ", ";
+    os << s[i];
+  }
+  os << "]";
+  return os.str();
+}
+
+const char* OpName(RequestType t) {
+  switch (t) {
+    case RequestType::kAllreduce: return "allreduce";
+    case RequestType::kAllgather: return "allgather";
+    case RequestType::kBroadcast: return "broadcast";
+  }
+  return "?";
+}
+
+struct Pending {
+  std::vector<Request> requests;
+  std::set<int32_t> ranks;
+  double first_seen = 0;
+};
+
+class Coordinator {
+ public:
+  Coordinator(int size, int64_t fusion_threshold)
+      : size_(size), fusion_threshold_(fusion_threshold) {}
+
+  // ≙ IncrementTensorCount (operations.cc:222-247).
+  // Returns 1 when all replicas reported, 0 pending, -1 duplicate rank.
+  int Submit(const Request& req) {
+    std::lock_guard<std::mutex> g(mu_);
+    Pending& p = table_[req.tensor_name];
+    if (p.requests.empty()) p.first_seen = MonotonicSeconds();
+    if (p.ranks.count(req.request_rank)) return -1;
+    p.ranks.insert(req.request_rank);
+    p.requests.push_back(req);
+    if (static_cast<int>(p.ranks.size()) == size_) {
+      ready_.push_back(req.tensor_name);
+      return 1;
+    }
+    return 0;
+  }
+
+  // ≙ ConstructMPIResponse (operations.cc:255-461).
+  Response ConstructResponse(const std::string& name) {
+    Pending p = std::move(table_[name]);
+    table_.erase(name);
+    std::sort(p.requests.begin(), p.requests.end(),
+              [](const Request& a, const Request& b) {
+                return a.request_rank < b.request_rank;
+              });
+    const Request& first = p.requests[0];
+    std::string error;
+
+    for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
+      const Request& r = p.requests[i];
+      if (r.tensor_type != first.tensor_type) {
+        std::ostringstream os;
+        os << "Mismatched data types: One rank had type "
+           << DataTypeName(first.tensor_type) << ", but another rank had type "
+           << DataTypeName(r.tensor_type) << ".";
+        error = os.str();
+      }
+    }
+    for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
+      const Request& r = p.requests[i];
+      if (r.request_type != first.request_type) {
+        std::ostringstream os;
+        os << "Mismatched collective operations: One rank did an "
+           << OpName(first.request_type) << ", but another rank did an "
+           << OpName(r.request_type) << ".";
+        error = os.str();
+      }
+    }
+    RequestType op = first.request_type;
+    std::vector<int64_t> tensor_sizes;
+    if (error.empty() && op == RequestType::kAllreduce) {
+      for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
+        const Request& r = p.requests[i];
+        if (r.tensor_shape != first.tensor_shape) {
+          std::ostringstream os;
+          os << "Mismatched allreduce tensor shapes: One rank sent a tensor "
+             << "of shape " << ShapeStr(first.tensor_shape)
+             << ", but another rank sent a tensor of shape "
+             << ShapeStr(r.tensor_shape) << ".";
+          error = os.str();
+        }
+      }
+    }
+    if (error.empty() && op == RequestType::kAllgather) {
+      if (first.tensor_shape.empty()) {
+        error = "Rank zero tried to gather a rank-zero tensor.";
+      }
+      for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
+        const Request& r = p.requests[i];
+        if (r.tensor_shape.size() != first.tensor_shape.size()) {
+          std::ostringstream os;
+          os << "Mismatched allgather tensor shapes: One rank sent a tensor "
+             << "of rank " << first.tensor_shape.size()
+             << ", but another rank sent a tensor of rank "
+             << r.tensor_shape.size() << ".";
+          error = os.str();
+          break;
+        }
+        for (size_t dim = 1; dim < first.tensor_shape.size(); ++dim) {
+          if (r.tensor_shape[dim] != first.tensor_shape[dim]) {
+            std::ostringstream os;
+            os << "Mismatched allgather tensor shapes: One rank sent a tensor "
+               << "with dimension " << dim << " equal to "
+               << first.tensor_shape[dim]
+               << ", but another rank sent a tensor with dimension " << dim
+               << " equal to " << r.tensor_shape[dim] << ".";
+            error = os.str();
+            break;
+          }
+        }
+      }
+      if (error.empty()) {
+        for (const Request& r : p.requests)
+          tensor_sizes.push_back(r.tensor_shape.empty() ? 0
+                                                        : r.tensor_shape[0]);
+      }
+    }
+    if (error.empty() && op == RequestType::kBroadcast) {
+      for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
+        const Request& r = p.requests[i];
+        if (r.root_rank != first.root_rank) {
+          std::ostringstream os;
+          os << "Mismatched broadcast root ranks: One rank specified root "
+             << "rank " << first.root_rank
+             << ", but another rank specified root rank " << r.root_rank
+             << ".";
+          error = os.str();
+        }
+      }
+      for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
+        const Request& r = p.requests[i];
+        if (r.tensor_shape != first.tensor_shape) {
+          std::ostringstream os;
+          os << "Mismatched broadcast tensor shapes: One rank sent a tensor "
+             << "of shape " << ShapeStr(first.tensor_shape)
+             << ", but another rank sent a tensor of shape "
+             << ShapeStr(r.tensor_shape) << ".";
+          error = os.str();
+        }
+      }
+    }
+    // Host/device placement agreement (≙ operations.cc:418-440).
+    for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
+      const Request& r = p.requests[i];
+      if ((r.device == kCpuDeviceId) != (first.device == kCpuDeviceId)) {
+        std::ostringstream os;
+        os << "Mismatched host/device selection: One rank specified device "
+           << first.device << ", but another rank specified device "
+           << r.device << ".";
+        error = os.str();
+      }
+    }
+
+    Response resp;
+    resp.tensor_names = {name};
+    if (!error.empty()) {
+      resp.response_type = ResponseType::kError;
+      resp.error_message = error;
+      return resp;
+    }
+    dtype_by_name_[name] = first.tensor_type;
+    for (const Request& r : p.requests) resp.devices.push_back(r.device);
+    switch (op) {
+      case RequestType::kAllreduce:
+        resp.response_type = ResponseType::kAllreduce;
+        break;
+      case RequestType::kAllgather:
+        resp.response_type = ResponseType::kAllgather;
+        resp.tensor_sizes = std::move(tensor_sizes);
+        break;
+      case RequestType::kBroadcast:
+        resp.response_type = ResponseType::kBroadcast;
+        break;
+    }
+    return resp;
+  }
+
+  // ≙ the response fusion loop (operations.cc:1328-1374): same-device,
+  // same-dtype ALLREDUCE responses merge under the byte threshold.
+  // `sizes` maps tensor name → payload bytes of one replica's tensor.
+  int PollResponses(const std::unordered_map<std::string, int64_t>& sizes) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<Response> responses;
+    for (const auto& n : ready_) responses.push_back(ConstructResponse(n));
+    ready_.clear();
+    std::vector<Response> fused;
+    for (size_t i = 0; i < responses.size(); ++i) {
+      Response r = std::move(responses[i]);
+      if (r.response_type != ResponseType::kAllreduce) {
+        fused.push_back(std::move(r));
+        continue;
+      }
+      auto szit = sizes.find(r.tensor_names[0]);
+      int64_t total = szit == sizes.end() ? 0 : szit->second;
+      DataType dt = dtype_by_name_[r.tensor_names[0]];
+      for (size_t j = i + 1; j < responses.size();) {
+        Response& nxt = responses[j];
+        auto nit = sizes.find(nxt.tensor_names.empty()
+                                  ? std::string()
+                                  : nxt.tensor_names[0]);
+        int64_t nbytes = nit == sizes.end() ? 0 : nit->second;
+        if (nxt.response_type == ResponseType::kAllreduce &&
+            nxt.devices == r.devices && !nxt.tensor_names.empty() &&
+            dtype_by_name_[nxt.tensor_names[0]] == dt &&
+            total + nbytes <= fusion_threshold_) {
+          r.tensor_names.push_back(nxt.tensor_names[0]);
+          total += nbytes;
+          responses.erase(responses.begin() + j);
+        } else {
+          ++j;
+        }
+      }
+      fused.push_back(std::move(r));
+    }
+    for (const auto& r : fused)
+      for (const auto& n : r.tensor_names) dtype_by_name_.erase(n);
+    out_buffer_ = PackResponseList(fused);
+    return static_cast<int>(fused.size());
+  }
+
+  ssize_t FetchResponses(char* out, size_t cap) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (out_buffer_.size() > cap) return -1;
+    std::memcpy(out, out_buffer_.data(), out_buffer_.size());
+    return static_cast<ssize_t>(out_buffer_.size());
+  }
+
+  // ≙ CheckForStalledTensors (operations.cc:1072-1115).
+  std::string CheckStalled(double threshold_seconds) {
+    std::lock_guard<std::mutex> g(mu_);
+    double now = MonotonicSeconds();
+    std::ostringstream os;
+    for (const auto& kv : table_) {
+      const Pending& p = kv.second;
+      double waited = now - p.first_seen;
+      if (waited > threshold_seconds) {
+        std::set<int32_t> missing;
+        for (int32_t r = 0; r < size_; ++r)
+          if (!p.ranks.count(r)) missing.insert(r);
+        os << "Tensor " << kv.first << " has been pending for "
+           << static_cast<long>(waited) << "s; ready replicas: [";
+        bool f = true;
+        for (int32_t r : p.ranks) {
+          if (!f) os << ", ";
+          os << r;
+          f = false;
+        }
+        os << "]; waiting on replicas: [";
+        f = true;
+        for (int32_t r : missing) {
+          if (!f) os << ", ";
+          os << r;
+          f = false;
+        }
+        os << "]. One or more replicas submitted this collective and are "
+           << "waiting for the remaining replicas to do the same.\n";
+      }
+    }
+    return os.str();
+  }
+
+ private:
+  int size_;
+  int64_t fusion_threshold_;
+  std::mutex mu_;
+  std::map<std::string, Pending> table_;
+  std::vector<std::string> ready_;
+  std::unordered_map<std::string, DataType> dtype_by_name_;
+  std::string out_buffer_;
+};
+
+// Side-table parser: u16 count, then (u16 klen, key, i64 bytes)*.
+bool ParseSizes(const uint8_t* buf, size_t len,
+                std::unordered_map<std::string, int64_t>* out) {
+  size_t off = 0;
+  uint16_t n;
+  if (off + 2 > len) return false;
+  std::memcpy(&n, buf + off, 2);
+  off += 2;
+  for (uint16_t i = 0; i < n; ++i) {
+    uint16_t klen;
+    if (off + 2 > len) return false;
+    std::memcpy(&klen, buf + off, 2);
+    off += 2;
+    if (off + klen + 8 > len) return false;
+    std::string key(reinterpret_cast<const char*>(buf + off), klen);
+    off += klen;
+    int64_t v;
+    std::memcpy(&v, buf + off, 8);
+    off += 8;
+    (*out)[key] = v;
+  }
+  return true;
+}
+
+}  // namespace
+}  // namespace hvdtpu
+
+extern "C" {
+
+void* hvd_coord_create(int size, long long fusion_threshold) {
+  return new hvdtpu::Coordinator(size, fusion_threshold);
+}
+
+void hvd_coord_destroy(void* c) {
+  delete static_cast<hvdtpu::Coordinator*>(c);
+}
+
+int hvd_coord_submit(void* c, const char* buf, int len) {
+  hvdtpu::Request req;
+  if (hvdtpu::Request::Unpack(reinterpret_cast<const uint8_t*>(buf), len,
+                              &req) < 0)
+    return -2;
+  return static_cast<hvdtpu::Coordinator*>(c)->Submit(req);
+}
+
+int hvd_coord_poll_responses(void* c, const char* sizes_buf, int sizes_len,
+                             double now_unused) {
+  (void)now_unused;
+  std::unordered_map<std::string, int64_t> sizes;
+  if (!hvdtpu::ParseSizes(reinterpret_cast<const uint8_t*>(sizes_buf),
+                          sizes_len, &sizes))
+    return -1;
+  return static_cast<hvdtpu::Coordinator*>(c)->PollResponses(sizes);
+}
+
+int hvd_coord_fetch_responses(void* c, char* out, int cap) {
+  return static_cast<int>(
+      static_cast<hvdtpu::Coordinator*>(c)->FetchResponses(out, cap));
+}
+
+int hvd_coord_check_stalled(void* c, double threshold, char* out, int cap) {
+  std::string s =
+      static_cast<hvdtpu::Coordinator*>(c)->CheckStalled(threshold);
+  if (static_cast<int>(s.size()) > cap) return -1;
+  std::memcpy(out, s.data(), s.size());
+  return static_cast<int>(s.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,170 @@
+"""ctypes loader for the native runtime library ``libhvdtpu.so``.
+
+The reference implements its runtime (coordinator, wire protocol, timeline,
+handle manager — horovod/common/*.cc, horovod/torch/handle_manager.cc) in
+C++; this package does the same for the pieces that remain host-side under
+the TPU design:
+
+* ``handle_manager.cc``  — atomic async-handle bookkeeping
+                           (≙ torch/handle_manager.cc)
+* ``wire.cc``            — compact binary serialization of control messages
+                           (≙ common/mpi_message.cc + wire/mpi_message.fbs)
+* ``coordinator.cc``     — name-keyed request table, readiness counting,
+                           cross-replica shape/dtype/device validation,
+                           fusion planning, stall detection
+                           (≙ common/operations.cc:222-461, :1072-1115)
+* ``timeline.cc``        — Chrome-tracing JSON writer (≙ common/timeline.cc)
+
+Loading strategy: try the prebuilt ``libhvdtpu.so`` next to this file; if
+absent, attempt a quick in-tree build with ``make`` (the sources are small);
+if that fails (no toolchain), fall back to pure-Python implementations with
+identical observable behavior so the package always works from a fresh
+checkout.  ``NATIVE`` reports which path is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_DIR, "libhvdtpu.so")
+
+_lib = None
+NATIVE = False
+
+
+def _try_build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s", "-C", _DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def _load() -> None:
+    global _lib, NATIVE
+    if os.environ.get("HVD_TPU_DISABLE_NATIVE"):
+        return
+    if not os.path.exists(_SO_PATH):
+        if not os.path.exists(os.path.join(_DIR, "Makefile")) or not _try_build():
+            return
+    try:
+        _lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        _lib = None
+        return
+    # Signatures.
+    _lib.hvd_handle_manager_create.restype = ctypes.c_void_p
+    _lib.hvd_handle_manager_allocate.argtypes = [ctypes.c_void_p]
+    _lib.hvd_handle_manager_allocate.restype = ctypes.c_int
+    _lib.hvd_handle_manager_mark_done.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    _lib.hvd_handle_manager_poll.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    _lib.hvd_handle_manager_poll.restype = ctypes.c_int
+    _lib.hvd_handle_manager_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    _lib.hvd_handle_manager_destroy.argtypes = [ctypes.c_void_p]
+
+    _lib.hvd_coord_create.argtypes = [ctypes.c_int, ctypes.c_longlong]
+    _lib.hvd_coord_create.restype = ctypes.c_void_p
+    _lib.hvd_coord_destroy.argtypes = [ctypes.c_void_p]
+    _lib.hvd_coord_submit.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    _lib.hvd_coord_submit.restype = ctypes.c_int
+    _lib.hvd_coord_poll_responses.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_double]
+    _lib.hvd_coord_poll_responses.restype = ctypes.c_int
+    _lib.hvd_coord_fetch_responses.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    _lib.hvd_coord_fetch_responses.restype = ctypes.c_int
+    _lib.hvd_coord_check_stalled.argtypes = [
+        ctypes.c_void_p, ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
+    _lib.hvd_coord_check_stalled.restype = ctypes.c_int
+
+    _lib.hvd_timeline_create.argtypes = [ctypes.c_char_p]
+    _lib.hvd_timeline_create.restype = ctypes.c_void_p
+    _lib.hvd_handle_manager_create.argtypes = []
+    _lib.hvd_timeline_event.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_double]
+    _lib.hvd_timeline_close.argtypes = [ctypes.c_void_p]
+    NATIVE = True
+
+
+_load()
+
+
+# ---------------------------------------------------------------------------
+# Handle manager facade (native when available, Python fallback otherwise).
+# ---------------------------------------------------------------------------
+
+class _PyHandleManager:
+    """Python fallback mirroring native/handle_manager.cc (itself mirroring
+    reference torch/handle_manager.cc:21-51)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 0
+        self._done: dict[int, bool] = {}
+
+    def allocate(self) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._done[h] = False
+            return h
+
+    def mark_done(self, h: int) -> None:
+        with self._lock:
+            if h in self._done:
+                self._done[h] = True
+
+    def poll(self, h: int) -> bool:
+        with self._lock:
+            return self._done.get(h, False)
+
+    def release(self, h: int) -> None:
+        with self._lock:
+            self._done.pop(h, None)
+
+
+def handle_manager_create():
+    if NATIVE:
+        return _lib.hvd_handle_manager_create()
+    return _PyHandleManager()
+
+
+def handle_manager_allocate(hm) -> int:
+    if NATIVE:
+        return _lib.hvd_handle_manager_allocate(hm)
+    return hm.allocate()
+
+
+def handle_manager_mark_done(hm, h: int) -> None:
+    if NATIVE:
+        _lib.hvd_handle_manager_mark_done(hm, h)
+    else:
+        hm.mark_done(h)
+
+
+def handle_manager_poll(hm, h: int) -> bool:
+    if NATIVE:
+        return bool(_lib.hvd_handle_manager_poll(hm, h))
+    return hm.poll(h)
+
+
+def handle_manager_release(hm, h: int) -> None:
+    if NATIVE:
+        _lib.hvd_handle_manager_release(hm, h)
+    else:
+        hm.release(h)
+
+
+def raw() -> ctypes.CDLL | None:
+    return _lib

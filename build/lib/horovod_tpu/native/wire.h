@@ -1,0 +1,61 @@
+// Wire format for control messages — C++ twin of horovod_tpu/ops/wire.py.
+//
+// TPU-native re-design of the reference's flatbuffers control layer
+// (horovod/common/mpi_message.{h,cc}, wire/mpi_message.fbs): hand-rolled
+// little-endian structs, since the messages are tiny and only travel the
+// dynamic path (eager ops / variable allgather / error negotiation).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// ≙ MPIDataType (mpi_message.h:26-36) + bfloat16/float16 for TPU.
+enum class DataType : uint8_t {
+  kUint8 = 0, kInt8 = 1, kUint16 = 2, kInt16 = 3, kInt32 = 4, kInt64 = 5,
+  kFloat32 = 6, kFloat64 = 7, kBool = 8, kBfloat16 = 9, kFloat16 = 10,
+};
+
+const char* DataTypeName(DataType t);
+
+// ≙ MPIRequestType / MPIResponseType (mpi_message.h).
+enum class RequestType : uint8_t { kAllreduce = 0, kAllgather = 1,
+                                   kBroadcast = 2 };
+enum class ResponseType : uint8_t { kAllreduce = 0, kAllgather = 1,
+                                    kBroadcast = 2, kError = 3, kDone = 4,
+                                    kShutdown = 5 };
+
+constexpr int kCpuDeviceId = -1;  // ≙ CPU_DEVICE_ID (common.h:28)
+
+// ≙ MPIRequest (mpi_message.h:43-85).
+struct Request {
+  RequestType request_type;
+  DataType tensor_type;
+  int32_t request_rank;
+  int32_t root_rank;
+  int32_t device;
+  std::string tensor_name;
+  std::vector<int64_t> tensor_shape;
+
+  std::string Pack() const;
+  // Returns bytes consumed, or -1 on malformed input.
+  static ssize_t Unpack(const uint8_t* buf, size_t len, Request* out);
+};
+
+// ≙ MPIResponse (mpi_message.h:112-157).
+struct Response {
+  ResponseType response_type;
+  std::vector<std::string> tensor_names;
+  std::string error_message;
+  std::vector<int32_t> devices;
+  std::vector<int64_t> tensor_sizes;  // allgather dim-0 per rank
+
+  std::string Pack() const;
+};
+
+std::string PackResponseList(const std::vector<Response>& rs);
+
+}  // namespace hvdtpu

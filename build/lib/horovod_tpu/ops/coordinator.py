@@ -1,0 +1,368 @@
+"""Dynamic-path coordinator: negotiation, validation, fusion, stall watch.
+
+TPU-native re-design of the reference coordinator that lives inside
+``BackgroundThreadLoop`` (horovod/common/operations.cc:1167-1475).  Under
+SPMD the *static* path (collectives traced into a jitted step) needs no
+runtime agreement — the compiled XLA program is identical on every host and
+the compiler schedules the ICI collectives.  What remains irreducible is the
+dynamic path: eager collectives issued one at a time, variable-size
+allgather, and cross-replica consistency checking.  This module reproduces
+that machinery observably:
+
+* name-keyed request table with readiness counting
+  (≙ ``IncrementTensorCount``, operations.cc:222-247),
+* cross-replica type/dtype/shape/root/device validation with the
+  reference's error-message shapes (≙ ``ConstructMPIResponse``,
+  operations.cc:255-461),
+* response fusion — same-dtype, same-device ALLREDUCE responses merge while
+  the summed payload stays under the fusion threshold
+  (≙ operations.cc:1328-1374; threshold env ``HOROVOD_FUSION_THRESHOLD``,
+  default 64 MB, operations.cc:140),
+* stall detection — tensors stuck in negotiation longer than 60 s are
+  reported with the set of ready vs. missing replicas
+  (≙ ``CheckForStalledTensors``, operations.cc:1072-1115, cadence
+  operations.cc:208-209),
+* cooperative shutdown (≙ operations.cc:1377-1403).
+
+When the native library is built the same logic runs in C++
+(native/coordinator.cc) over the shared wire format; this Python class is
+the behavior-identical fallback and the executable specification.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from . import wire
+from .wire import (DataType, Request, RequestType, Response, ResponseType)
+from ..native import lib as _native
+
+# Seconds a tensor may sit in negotiation before a stall warning
+# (≙ STALL_WARNING_TIME, operations.cc:208).
+STALL_WARNING_SECONDS = 60.0
+
+
+@dataclass
+class _PendingTensor:
+    requests: List[Request] = field(default_factory=list)
+    ranks: set = field(default_factory=set)
+    first_seen: float = 0.0
+
+
+class PyCoordinator:
+    """Pure-Python coordinator (executable spec for native/coordinator.cc).
+
+    Mutex-guarded like its C++ twin (and like the reference's single global
+    mutex, operations.cc:113): ``submit`` runs on user threads while
+    ``poll_responses`` runs on the background drain thread.
+    """
+
+    def __init__(self, size: int, fusion_threshold: int):
+        self.size = size
+        self.fusion_threshold = fusion_threshold
+        self._lock = threading.Lock()
+        self.table: Dict[str, _PendingTensor] = {}
+        self.ready: List[str] = []
+        # dtype per constructed response, for fusion compatibility checks
+        # (the reference reads this from its TensorTable during the fusion
+        # loop, operations.cc:1328-1374).
+        self._resp_dtype: Dict[str, DataType] = {}
+        self.shutdown = False
+
+    # -- IncrementTensorCount (operations.cc:222-247) ----------------------
+    def submit(self, req: Request, now: Optional[float] = None) -> bool:
+        """Record one replica's request; returns True when all replicas have
+        reported the tensor (negotiation complete)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            entry = self.table.get(req.tensor_name)
+            if entry is None:
+                entry = _PendingTensor(first_seen=now)
+                self.table[req.tensor_name] = entry
+            if req.request_rank in entry.ranks:
+                raise ValueError(
+                    f"Duplicate request for tensor {req.tensor_name} from "
+                    f"replica {req.request_rank}; a name may be used by at "
+                    f"most one pending collective per replica.")
+            entry.requests.append(req)
+            entry.ranks.add(req.request_rank)
+            if len(entry.ranks) == self.size:
+                self.ready.append(req.tensor_name)
+                return True
+            return False
+
+    # -- ConstructMPIResponse (operations.cc:255-461) ----------------------
+    def construct_response(self, name: str) -> Response:
+        with self._lock:
+            return self._construct_response_locked(name)
+
+    def _construct_response_locked(self, name: str) -> Response:
+        entry = self.table.pop(name)
+        reqs = sorted(entry.requests, key=lambda r: r.request_rank)
+        first = reqs[0]
+        error = None
+
+        # Data-type agreement (operations.cc:266-279).
+        for r in reqs[1:]:
+            if r.tensor_type != first.tensor_type:
+                error = (f"Mismatched data types: One rank had type "
+                         f"{wire.dtype_name(first.tensor_type)}, but another "
+                         f"rank had type {wire.dtype_name(r.tensor_type)}.")
+                break
+        # Operation agreement (operations.cc:283-296).
+        if error is None:
+            for r in reqs[1:]:
+                if r.request_type != first.request_type:
+                    error = (f"Mismatched collective operations: One rank did "
+                             f"an {first.request_type.name.lower()}, but "
+                             f"another rank did an "
+                             f"{r.request_type.name.lower()}.")
+                    break
+        op = first.request_type
+        # Allreduce: full shape agreement (operations.cc:299-330).
+        if error is None and op == RequestType.ALLREDUCE:
+            for r in reqs[1:]:
+                if r.tensor_shape != first.tensor_shape:
+                    error = (f"Mismatched allreduce tensor shapes: One rank "
+                             f"sent a tensor of shape "
+                             f"{list(first.tensor_shape)}, but another rank "
+                             f"sent a tensor of shape "
+                             f"{list(r.tensor_shape)}.")
+                    break
+        # Allgather: same ndim, same non-first dims (operations.cc:334-392).
+        tensor_sizes: List[int] = []
+        if error is None and op == RequestType.ALLGATHER:
+            if len(first.tensor_shape) == 0:
+                error = "Rank zero tried to gather a rank-zero tensor."
+            else:
+                for r in reqs[1:]:
+                    if len(r.tensor_shape) != len(first.tensor_shape):
+                        error = (
+                            f"Mismatched allgather tensor shapes: One rank "
+                            f"sent a tensor of rank {len(first.tensor_shape)},"
+                            f" but another rank sent a tensor of rank "
+                            f"{len(r.tensor_shape)}.")
+                        break
+                    for dim in range(1, len(first.tensor_shape)):
+                        if r.tensor_shape[dim] != first.tensor_shape[dim]:
+                            error = (
+                                f"Mismatched allgather tensor shapes: One "
+                                f"rank sent a tensor with dimension {dim} "
+                                f"equal to {first.tensor_shape[dim]}, but "
+                                f"another rank sent a tensor with dimension "
+                                f"{dim} equal to {r.tensor_shape[dim]}.")
+                            break
+                    if error:
+                        break
+            if error is None:
+                tensor_sizes = [r.tensor_shape[0] for r in reqs]
+        # Broadcast: root agreement + shape agreement
+        # (operations.cc:396-431).
+        if error is None and op == RequestType.BROADCAST:
+            for r in reqs[1:]:
+                if r.root_rank != first.root_rank:
+                    error = (f"Mismatched broadcast root ranks: One rank "
+                             f"specified root rank {first.root_rank}, but "
+                             f"another rank specified root rank "
+                             f"{r.root_rank}.")
+                    break
+            if error is None:
+                for r in reqs[1:]:
+                    if r.tensor_shape != first.tensor_shape:
+                        error = (f"Mismatched broadcast tensor shapes: One "
+                                 f"rank sent a tensor of shape "
+                                 f"{list(first.tensor_shape)}, but another "
+                                 f"rank sent a tensor of shape "
+                                 f"{list(r.tensor_shape)}.")
+                        break
+        # Device agreement (operations.cc:418-440): collectives must run on a
+        # consistent device class across replicas.
+        if error is None:
+            for r in reqs[1:]:
+                if (r.device == wire.CPU_DEVICE_ID) != (
+                        first.device == wire.CPU_DEVICE_ID):
+                    error = (f"Mismatched host/device selection: One rank "
+                             f"specified device {first.device}, but another "
+                             f"rank specified device {r.device}.")
+                    break
+
+        if error is not None:
+            return Response(ResponseType.ERROR, [name], error_message=error)
+        self._resp_dtype[name] = first.tensor_type
+        devices = [r.device for r in reqs]
+        if op == RequestType.ALLREDUCE:
+            return Response(ResponseType.ALLREDUCE, [name], devices=devices)
+        if op == RequestType.ALLGATHER:
+            return Response(ResponseType.ALLGATHER, [name], devices=devices,
+                            tensor_sizes=tensor_sizes)
+        return Response(ResponseType.BROADCAST, [name], devices=devices)
+
+    # -- Fusion loop (operations.cc:1328-1374) -----------------------------
+    def poll_responses(self, sizes_bytes: Dict[str, int]) -> List[Response]:
+        """Drain ready tensors into (possibly fused) responses.
+
+        ``sizes_bytes`` maps tensor name → payload bytes, used to respect the
+        fusion threshold exactly like the reference's
+        ``TensorFusionThresholdBytes`` accounting.
+        """
+        with self._lock:
+            ready, self.ready = self.ready, []
+            responses = [self._construct_response_locked(n) for n in ready]
+        fused: List[Response] = []
+        i = 0
+        while i < len(responses):
+            r = responses[i]
+            i += 1
+            if r.response_type != ResponseType.ALLREDUCE:
+                fused.append(r)
+                continue
+            total = sizes_bytes.get(r.tensor_names[0], 0)
+            dtype = self._resp_dtype.get(r.tensor_names[0])
+            j = i
+            while j < len(responses):
+                nxt = responses[j]
+                if (nxt.response_type == ResponseType.ALLREDUCE
+                        and nxt.devices == r.devices
+                        and self._resp_dtype.get(nxt.tensor_names[0]) == dtype
+                        and total + sizes_bytes.get(nxt.tensor_names[0], 0)
+                        <= self.fusion_threshold):
+                    r.tensor_names.extend(nxt.tensor_names)
+                    total += sizes_bytes.get(nxt.tensor_names[0], 0)
+                    responses.pop(j)
+                else:
+                    j += 1
+            fused.append(r)
+        for r in fused:
+            for n in r.tensor_names:
+                self._resp_dtype.pop(n, None)
+        return fused
+
+    # -- CheckForStalledTensors (operations.cc:1072-1115) ------------------
+    def check_stalled(self, now: Optional[float] = None,
+                      threshold: float = STALL_WARNING_SECONDS) -> List[str]:
+        now = time.monotonic() if now is None else now
+        warnings = []
+        with self._lock:
+            items = list(self.table.items())
+        for name, entry in items:
+            if now - entry.first_seen > threshold:
+                ready = sorted(entry.ranks)
+                missing = sorted(set(range(self.size)) - entry.ranks)
+                warnings.append(
+                    f"Tensor {name} has been pending for "
+                    f"{now - entry.first_seen:.0f}s; ready replicas: {ready}; "
+                    f"waiting on replicas: {missing}. One or more replicas "
+                    f"submitted this collective and are waiting for the "
+                    f"remaining replicas to do the same.")
+        return warnings
+
+    def request_shutdown(self) -> None:
+        self.shutdown = True
+
+    def close(self) -> None:
+        self.table.clear()
+        self.ready.clear()
+
+
+class NativeCoordinator:
+    """ctypes facade over native/coordinator.cc (same wire format)."""
+
+    def __init__(self, size: int, fusion_threshold: int):
+        self._lib = _native.raw()
+        self._ptr = self._lib.hvd_coord_create(size, fusion_threshold)
+        self.size = size
+        self.fusion_threshold = fusion_threshold
+
+    def submit(self, req: Request, now: Optional[float] = None) -> bool:
+        buf = req.pack()
+        rc = self._lib.hvd_coord_submit(self._ptr, buf, len(buf))
+        if rc == -1:
+            raise ValueError(
+                f"Duplicate request for tensor {req.tensor_name} from replica "
+                f"{req.request_rank}; a name may be used by at most one "
+                f"pending collective per replica.")
+        if rc < 0:
+            raise RuntimeError(
+                f"Native coordinator rejected a malformed request buffer for "
+                f"tensor {req.tensor_name} (wire-format mismatch between "
+                f"ops/wire.py and native/wire.cc?).")
+        return bool(rc)
+
+    def poll_responses(self, sizes_bytes: Dict[str, int]) -> List[Response]:
+        import ctypes
+        # Ship the payload sizes as a serialized side table.
+        import struct
+        side = struct.pack("<H", len(sizes_bytes))
+        for k, v in sizes_bytes.items():
+            kb = k.encode()
+            side += struct.pack("<H", len(kb)) + kb + struct.pack("<q", v)
+        cap = 1 << 20
+        out = ctypes.create_string_buffer(cap)
+        n = self._lib.hvd_coord_poll_responses(self._ptr, side, len(side), 0.0)
+        if n < 0:
+            raise RuntimeError("native coordinator poll failed")
+        # Responses are fetched via a second call writing into out.
+        n = self._lib.hvd_coord_fetch_responses(self._ptr, out, cap)
+        if n < 0:
+            raise RuntimeError("native coordinator fetch overflow")
+        return wire.unpack_response_list(out.raw[:n])
+
+    def check_stalled(self, now: Optional[float] = None,
+                      threshold: float = STALL_WARNING_SECONDS) -> List[str]:
+        import ctypes
+        cap = 1 << 16
+        out = ctypes.create_string_buffer(cap)
+        n = self._lib.hvd_coord_check_stalled(
+            self._ptr, threshold, out, cap)
+        if n <= 0:
+            return []
+        text = out.raw[:n].decode("utf-8")
+        return [w for w in text.split("\n") if w]
+
+    def close(self) -> None:
+        if self._ptr:
+            self._lib.hvd_coord_destroy(self._ptr)
+            self._ptr = None
+
+
+class Coordinator:
+    """Facade selecting the native coordinator when built, Python otherwise,
+    and layering the timeline + stderr stall reporting over either."""
+
+    def __init__(self, size: int, fusion_threshold: int, timeline=None):
+        self.timeline = timeline
+        self._last_stall_check = time.monotonic()
+        if _native.NATIVE and hasattr(_native.raw(), "hvd_coord_fetch_responses"):
+            self._impl = NativeCoordinator(size, fusion_threshold)
+        else:
+            self._impl = PyCoordinator(size, fusion_threshold)
+        self.size = size
+
+    def submit(self, req: Request) -> bool:
+        if self.timeline is not None:
+            self.timeline.negotiate_rank_ready(req.tensor_name,
+                                               req.request_rank,
+                                               first=req.request_rank == 0)
+        done = self._impl.submit(req)
+        if done and self.timeline is not None:
+            self.timeline.negotiate_end(req.tensor_name)
+        return done
+
+    def poll_responses(self, sizes_bytes: Dict[str, int]) -> List[Response]:
+        now = time.monotonic()
+        if now - self._last_stall_check > STALL_WARNING_SECONDS:
+            self._last_stall_check = now
+            for w in self._impl.check_stalled(now):
+                print(f"WARNING: {w}", file=sys.stderr)
+        return self._impl.poll_responses(sizes_bytes)
+
+    def check_stalled(self, now=None, threshold=STALL_WARNING_SECONDS):
+        return self._impl.check_stalled(now, threshold)
+
+    def close(self) -> None:
+        self._impl.close()

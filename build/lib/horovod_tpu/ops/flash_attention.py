@@ -1,0 +1,435 @@
+"""Flash attention as a Pallas TPU kernel (forward + backward).
+
+The reference framework has no attention code at all (SURVEY.md §5,
+"Long-context / sequence parallelism: absent") — this is a beyond-parity
+component that the long-context stack (:mod:`..parallel.sequence`) builds
+on.  It is written TPU-first:
+
+* blocks are MXU/VPU aligned (q/k block sizes default to 128 lanes),
+* the softmax runs online (one pass over K/V, O(seq) memory instead of
+  O(seq²)) so HBM traffic is linear,
+* matmuls accumulate in float32 via ``preferred_element_type`` regardless
+  of input dtype (bfloat16 inputs stay MXU-friendly),
+* the backward pass is two Pallas kernels (dKdV then dQ) using the saved
+  log-sum-exp rows plus the standard ``delta = rowsum(dO * O)`` trick, so
+  nothing quadratic is ever materialized.
+
+On non-TPU backends (the CPU test mesh) the kernels run in Pallas
+interpreter mode; `flash_attention` is the single entry point either way.
+
+Layout: ``q, k, v : [batch, heads, seq, head_dim]``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _apply_mask(s, *, q_start, k_start, kv_actual, kv_padded, causal,
+                q_block_offset):
+    """Shared score mask for all three kernels: padded keys (past
+    ``kv_actual``) and, when ``causal``, future positions.  Forward and
+    backward MUST mask identically or gradients silently diverge."""
+    block_q, block_k = s.shape
+    if not causal and kv_actual == kv_padded:
+        return s
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1)
+    valid = k_pos < kv_actual
+    if causal:
+        q_pos = (q_start + q_block_offset
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 0))
+        valid = jnp.logical_and(valid, q_pos >= k_pos)
+    return jnp.where(valid, s, DEFAULT_MASK_VALUE)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
+                causal: bool, block_k: int, kv_seq_len: int,
+                kv_actual: int, q_block_offset: int):
+    """One (batch*head, q_block) grid cell: online-softmax over K blocks.
+
+    ``q_block_offset`` shifts the causal comparison for ring attention,
+    where the local q shard's global position differs from its local index.
+    ``kv_actual`` is the unpadded key count (keys past it are masked).
+    """
+    block_q = q_ref.shape[0]
+    head_dim = q_ref.shape[1]
+    q_idx = pl.program_id(1)
+
+    q = q_ref[:, :].astype(jnp.float32) * sm_scale
+    m_init = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l_init = jnp.zeros((block_q, 1), jnp.float32)
+    acc_init = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    num_k_blocks = pl.cdiv(kv_seq_len, block_k)
+
+    def body(kb, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        s = _apply_mask(s, q_start=q_idx * block_q, k_start=kb * block_k,
+                        kv_actual=kv_actual, kv_padded=kv_seq_len,
+                        causal=causal, q_block_offset=q_block_offset)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    if causal:
+        # Blocks entirely in the future contribute nothing — skip them.
+        # (Static bound; the loop extent depends only on the grid cell.)
+        hi = jnp.minimum(
+            num_k_blocks,
+            pl.cdiv((q_idx + 1) * block_q + q_block_offset, block_k))
+    else:
+        hi = num_k_blocks
+    m, l, acc = jax.lax.fori_loop(0, hi, body,
+                                  (m_init, l_init, acc_init))
+    # Rows with no visible keys: either no block executed (l == 0) or every
+    # entry carried the mask value (m stayed at the mask floor).  Emit
+    # zeros with lse = -inf rather than dividing by zero / averaging junk.
+    no_valid = jnp.logical_or(l == 0.0, m <= DEFAULT_MASK_VALUE * 0.5)
+    l_safe = jnp.where(no_valid, 1.0, l)
+    o_ref[:, :] = jnp.where(no_valid, 0.0,
+                            acc / l_safe).astype(o_ref.dtype)
+    lse = jnp.where(no_valid, -jnp.inf, m + jnp.log(l_safe))
+    lse_ref[:, :] = lse.astype(jnp.float32)
+
+
+def _pad_seq(x, multiple):
+    """Zero-pad the seq (next-to-last) axis up to a block multiple."""
+    s = x.shape[-2]
+    pad = (-s) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * (x.ndim - 2) + [(0, pad), (0, 0)]
+    return jnp.pad(x, widths)
+
+
+def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
+                   q_block_offset, interpret):
+    if interpret is None:
+        interpret = _interpret_default()
+    batch, heads, q_len, head_dim = q.shape
+    kv_len = k.shape[2]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, kv_len)
+
+    # Pad ragged tails up to block multiples; padded keys are masked in the
+    # kernel (kv_actual), padded q rows are sliced away below.
+    qr = _pad_seq(q.reshape(batch * heads, q_len, head_dim), block_q)
+    kr = _pad_seq(k.reshape(batch * heads, kv_len, head_dim), block_k)
+    vr = _pad_seq(v.reshape(batch * heads, kv_len, head_dim), block_k)
+    q_pad, kv_pad = qr.shape[1], kr.shape[1]
+
+    grid = (batch * heads, q_pad // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k,
+        kv_seq_len=kv_pad, kv_actual=kv_len,
+        q_block_offset=q_block_offset)
+    out_shape = [
+        jax.ShapeDtypeStruct((batch * heads, q_pad, head_dim), q.dtype),
+        jax.ShapeDtypeStruct((batch * heads, q_pad, 1), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, head_dim),
+                         lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, kv_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, kv_pad, head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, head_dim),
+                         lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(qr, kr, vr)
+    return (o[:, :q_len].reshape(batch, heads, q_len, head_dim),
+            lse[:, :q_len].reshape(batch, heads, q_len))
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, *, sm_scale: float, causal: bool,
+                     block_q: int, q_seq_len: int, kv_actual: int,
+                     q_block_offset: int):
+    """Grid cell (batch*head, k_block): accumulate dK, dV over q blocks."""
+    block_k = k_ref.shape[0]
+    head_dim = k_ref.shape[1]
+    k_idx = pl.program_id(1)
+    kv_padded = pl.num_programs(1) * block_k
+
+    k = k_ref[:, :].astype(jnp.float32)
+    v = v_ref[:, :].astype(jnp.float32)
+    dk_init = jnp.zeros((block_k, head_dim), jnp.float32)
+    dv_init = jnp.zeros((block_k, head_dim), jnp.float32)
+    num_q_blocks = pl.cdiv(q_seq_len, block_q)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(qb * block_q, block_q), :]
+        delta = delta_ref[pl.ds(qb * block_q, block_q), :]
+        s = jnp.dot(q, k.T,
+                    preferred_element_type=jnp.float32) * sm_scale
+        s = _apply_mask(s, q_start=qb * block_q, k_start=k_idx * block_k,
+                        kv_actual=kv_actual, kv_padded=kv_padded,
+                        causal=causal, q_block_offset=q_block_offset)
+        # p = exp(s - lse); fully-masked rows have lse = -inf → p = 0;
+        # masked entries underflow exp(MASK - lse) → 0.
+        p = jnp.exp(s - jnp.where(jnp.isfinite(lse), lse, 0.0))
+        p = jnp.where(jnp.isfinite(lse), p, 0.0)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # q blocks strictly before this k block see none of it.
+        lo = jnp.maximum(
+            0, (k_idx * block_k - q_block_offset) // block_q)
+        lo = jnp.minimum(lo, num_q_blocks)
+    else:
+        lo = 0
+    dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (dk_init, dv_init))
+    dk_ref[:, :] = dk.astype(dk_ref.dtype)
+    dv_ref[:, :] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, sm_scale: float, causal: bool, block_k: int,
+                   kv_seq_len: int, kv_actual: int, q_block_offset: int):
+    """Grid cell (batch*head, q_block): accumulate dQ over k blocks."""
+    block_q = q_ref.shape[0]
+    head_dim = q_ref.shape[1]
+    q_idx = pl.program_id(1)
+
+    q = q_ref[:, :].astype(jnp.float32)
+    do = do_ref[:, :].astype(jnp.float32)
+    lse = lse_ref[:, :]
+    delta = delta_ref[:, :]
+    dq_init = jnp.zeros((block_q, head_dim), jnp.float32)
+    num_k_blocks = pl.cdiv(kv_seq_len, block_k)
+
+    def body(kb, dq):
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T,
+                    preferred_element_type=jnp.float32) * sm_scale
+        s = _apply_mask(s, q_start=q_idx * block_q, k_start=kb * block_k,
+                        kv_actual=kv_actual, kv_padded=kv_seq_len,
+                        causal=causal, q_block_offset=q_block_offset)
+        p = jnp.exp(s - jnp.where(jnp.isfinite(lse), lse, 0.0))
+        p = jnp.where(jnp.isfinite(lse), p, 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        hi = jnp.minimum(
+            num_k_blocks,
+            pl.cdiv((q_idx + 1) * block_q + q_block_offset, block_k))
+    else:
+        hi = num_k_blocks
+    dq = jax.lax.fori_loop(0, hi, body, dq_init)
+    dq_ref[:, :] = dq.astype(dq_ref.dtype)
+
+
+def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
+                    q_block_offset, interpret):
+    if interpret is None:
+        interpret = _interpret_default()
+    q, k, v, o, lse = res
+    batch, heads, q_len, head_dim = q.shape
+    kv_len = k.shape[2]
+    bq = min(block_q, q_len)
+    bk = min(block_k, kv_len)
+
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)  # [B,H,Sq]
+
+    flat = lambda x: x.reshape(batch * heads, x.shape[2], -1)
+    # Pad tails to block multiples.  Padded q rows carry lse = -inf so
+    # their p (and thus every contribution) is exactly zero; padded keys
+    # are masked via kv_actual.
+    qr = _pad_seq(flat(q), bq)
+    kr = _pad_seq(flat(k), bk)
+    vr = _pad_seq(flat(v), bk)
+    dor = _pad_seq(flat(do), bq)
+    lser = flat(lse[..., None])
+    pad_q = qr.shape[1] - q_len
+    if pad_q:
+        lser = jnp.pad(lser, ((0, 0), (0, pad_q), (0, 0)),
+                       constant_values=-jnp.inf)
+    deltar = _pad_seq(flat(delta[..., None]), bq)
+    q_pad, kv_pad = qr.shape[1], kr.shape[1]
+
+    dkdv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=bq, q_seq_len=q_pad,
+                          kv_actual=kv_len,
+                          q_block_offset=q_block_offset),
+        grid=(batch * heads, kv_pad // bk),
+        in_specs=[
+            pl.BlockSpec((None, q_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, q_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, q_pad, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, q_pad, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, kv_pad, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((batch * heads, kv_pad, head_dim), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)
+    dk, dv = dkdv
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=bk, kv_seq_len=kv_pad, kv_actual=kv_len,
+                          q_block_offset=q_block_offset),
+        grid=(batch * heads, q_pad // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, kv_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, kv_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, head_dim),
+                               lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, q_pad, head_dim),
+                                       q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)
+
+    rs = lambda x, n: x[:, :n].reshape(batch, heads, n, head_dim)
+    return rs(dq, q_len), rs(dk, kv_len), rs(dv, kv_len)
+
+
+# ---------------------------------------------------------------------------
+# Public entry points
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, q_block_offset,
+           interpret):
+    o, _ = _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
+                          q_block_offset, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, q_block_offset,
+               interpret):
+    o, lse = _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
+                            q_block_offset, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, q_block_offset,
+               interpret, res, g):
+    return _flash_backward(res, g, sm_scale=sm_scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           q_block_offset=q_block_offset,
+                           interpret=interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    sm_scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, q_block_offset: int = 0,
+                    interpret: Optional[bool] = None):
+    """Memory-linear attention, differentiable, Pallas-TPU compiled.
+
+    Args:
+      q, k, v: ``[batch, heads, seq, head_dim]`` (q_len may differ from
+        kv_len).
+      causal: apply a lower-triangular mask; future K blocks are skipped
+        entirely (compute proportional to the unmasked area).
+      sm_scale: softmax temperature; default ``1/sqrt(head_dim)``.
+      q_block_offset: global position of q's first row relative to k's
+        first row, for sequence-sharded callers (ring attention).
+      interpret: force Pallas interpreter mode (defaults to on for
+        non-TPU backends, e.g. the CPU test mesh).
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    return _flash(q, k, v, float(sm_scale), bool(causal), int(block_q),
+                  int(block_k), int(q_block_offset), bool(interpret))
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = False,
+                             sm_scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             q_block_offset: int = 0,
+                             interpret: Optional[bool] = None):
+    """Forward-only variant returning ``(out, lse)`` for callers that merge
+    partial attention across sequence shards (ring attention's online
+    softmax across devices)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    return _flash_forward(q, k, v, float(sm_scale), bool(causal),
+                          int(block_q), int(block_k), int(q_block_offset),
+                          bool(interpret))
+
+
+def mha_reference(q, k, v, *, causal: bool = False,
+                  sm_scale: Optional[float] = None,
+                  q_block_offset: int = 0):
+    """O(seq²) reference attention (tests compare the kernel against it)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        q_len, k_len = q.shape[2], k.shape[2]
+        q_pos = q_block_offset + jnp.arange(q_len)[:, None]
+        k_pos = jnp.arange(k_len)[None, :]
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)  # fully-masked rows
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,104 @@
+"""Async handle manager for the eager collective API.
+
+Reference: horovod/torch/handle_manager.{h,cc} — an atomic counter plus a
+mutex-guarded map handle→Status that backs ``allreduce_async`` / ``poll`` /
+``synchronize`` (handle_manager.cc:21-51).
+
+On TPU the asynchrony is owned by XLA's async dispatch: every collective we
+launch returns a ``jax.Array`` future immediately.  The handle therefore maps
+to the in-flight result array (plus any host-side finalizer), and
+
+* ``poll(handle)``      → ``result.is_ready()``   (non-blocking, like the
+  reference's cudaEventQuery-based ready events — torch/ready_event.cc:65-72)
+* ``synchronize(handle)`` → block until ready, run the finalizer, release the
+  handle (reference: horovod_torch_wait_and_clear, torch/mpi_ops.cc:326-332,
+  minus the 1 ms poll loop — XLA gives us a real blocking wait).
+
+When the native runtime library is built, handle bookkeeping lives in C++
+(native/handle_manager.cc) exactly like the reference; this module falls back
+to a Python dict when the .so is absent.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from ..native import lib as _native
+
+
+class Handle:
+    """One in-flight eager collective."""
+
+    __slots__ = ("id", "result", "finalizer", "name")
+
+    def __init__(self, id: int, result: Any, finalizer: Optional[Callable], name: str):
+        self.id = id
+        self.result = result  # jax.Array or pytree of jax.Arrays
+        self.finalizer = finalizer  # host-side post-processing (e.g. unpad)
+        self.name = name
+
+
+class HandleManager:
+    """Allocates integer handles for async collectives.
+
+    The id counter and live-handle set are kept in the native library when
+    available (mirroring the reference's C++ HandleManager); the Python map
+    keeps the GC-visible references to the in-flight arrays, playing the role
+    of the reference's ``_handle_map`` which keeps tensors alive during the
+    async operation (torch/mpi_ops.py:27-30).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._handles: Dict[int, Handle] = {}
+        self._native = _native.handle_manager_create()
+
+    def allocate(self, result: Any, finalizer: Optional[Callable] = None,
+                 name: str = "") -> int:
+        hid = _native.handle_manager_allocate(self._native)
+        h = Handle(hid, result, finalizer, name)
+        with self._lock:
+            self._handles[hid] = h
+        return hid
+
+    def _get(self, handle: int) -> Handle:
+        with self._lock:
+            h = self._handles.get(handle)
+        if h is None:
+            raise ValueError(
+                f"Handle {handle} was not created or has already been cleared."
+            )
+        return h
+
+    def poll(self, handle: int) -> bool:
+        """Non-blocking readiness check."""
+        h = self._get(handle)
+        if h.result is None:
+            return False  # not yet launched (still queued for fusion)
+        leaves = jax.tree_util.tree_leaves(h.result)
+        ready = all(
+            leaf.is_ready() if hasattr(leaf, "is_ready") else True
+            for leaf in leaves
+        )
+        if ready:
+            _native.handle_manager_mark_done(self._native, handle)
+        return ready
+
+    def synchronize(self, handle: int) -> Any:
+        """Block until the collective completes; return its output."""
+        h = self._get(handle)
+        result = jax.block_until_ready(h.result)
+        if h.finalizer is not None:
+            result = h.finalizer(result)
+        _native.handle_manager_mark_done(self._native, handle)
+        with self._lock:
+            del self._handles[handle]
+        _native.handle_manager_release(self._native, handle)
+        return result
+
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._handles)

@@ -1,0 +1,102 @@
+"""Sparse-gradient collectives — the embedding/word2vec path.
+
+The reference allreduces ``tf.IndexedSlices`` gradients (sparse rows of an
+embedding matrix) as an *allgather of values and indices* instead of a
+dense allreduce (tensorflow/__init__.py:67-78, exercised by
+examples/tensorflow_word2vec.py:156-183): each rank contributes its touched
+rows; ranks then apply the union of updates.
+
+TPU-native design: the same gather-of-(values, indices) semantics via the
+variable-size allgather (XLA ``all_gather`` after size negotiation), plus a
+``scatter-sum`` densifier for applying the result — XLA lowers
+``segment_sum`` onto the TPU's native scatter path.  For embeddings small
+enough that a dense psum wins on ICI, ``as_dense`` + the dense path remains
+available; the choice mirrors the reference's ``device_dense`` /
+``device_sparse`` per-call override (tensorflow/__init__.py:49-60).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class IndexedSlices(NamedTuple):
+    """Sparse rows of a dense tensor (≙ tf.IndexedSlices as used by the
+    reference's sparse allreduce).  ``values[i]`` is the update for row
+    ``indices[i]`` of a tensor with shape ``dense_shape``."""
+
+    values: jax.Array    # [nnz, ...row shape]
+    indices: jax.Array   # [nnz] int32
+    dense_shape: Tuple[int, ...]
+
+
+def allreduce(slices, average: bool = True, name: Optional[str] = None):
+    """Allreduce an :class:`IndexedSlices` by gathering values + indices
+    from every replica (≙ tensorflow/__init__.py:67-78).
+
+    ``slices`` may be a single IndexedSlices (replicated contribution) or a
+    list of per-replica IndexedSlices with differing nnz (the realistic
+    case — each replica touched different rows).  Returns one
+    IndexedSlices holding the union of all contributions, with values
+    divided by the replica count when ``average`` (the reference divides
+    the gathered values the same way, tensorflow/__init__.py:75-77).
+    """
+    from . import collective as C
+    from ..core import state as _state
+
+    name = name or C._auto_name("sparse_allreduce")
+    if isinstance(slices, IndexedSlices):
+        values = C.allgather(slices.values, name=f"{name}.values")
+        indices = C.allgather(slices.indices, name=f"{name}.indices")
+        dense_shape = slices.dense_shape
+    else:
+        per = list(slices)
+        if not per:
+            raise ValueError("empty sparse allreduce")
+        values = C.allgather([s.values for s in per], name=f"{name}.values")
+        indices = C.allgather([s.indices for s in per],
+                              name=f"{name}.indices")
+        dense_shape = per[0].dense_shape
+    if average:
+        values = values / _state.size()
+    return IndexedSlices(values=values, indices=indices,
+                         dense_shape=dense_shape)
+
+
+def as_dense(slices: IndexedSlices) -> jax.Array:
+    """Scatter-sum the slices into the dense tensor (duplicate indices
+    accumulate — same semantics the frameworks apply to IndexedSlices)."""
+    num_rows = slices.dense_shape[0]
+    dense = jax.ops.segment_sum(slices.values, slices.indices,
+                                num_segments=num_rows)
+    return dense.reshape(slices.dense_shape)
+
+
+def apply_to(param: jax.Array, slices: IndexedSlices,
+             scale: float = 1.0) -> jax.Array:
+    """``param += scale * scatter(slices)`` without materializing the dense
+    gradient — the embedding-update fast path."""
+    return param.at[slices.indices].add(scale * slices.values)
+
+
+def sparse_grad_from_dense(dense_grad: jax.Array,
+                           touched_rows: jax.Array) -> IndexedSlices:
+    """Extract the touched rows of a dense embedding gradient as
+    IndexedSlices.  JAX computes embedding grads dense; this recovers the
+    reference's sparse form for wire-efficient exchange when
+    ``len(touched_rows) * row_bytes << dense bytes``.
+
+    Host-side (eager) helper: deduplication uses ``np.unique`` so the
+    result has exactly the unique touched rows, no padding — padded
+    duplicate indices would double-apply the last row's gradient when the
+    slices are scatter-accumulated.
+    """
+    import numpy as np
+
+    rows = jnp.asarray(np.unique(np.asarray(touched_rows)))
+    values = dense_grad[rows]
+    return IndexedSlices(values=values, indices=rows,
+                         dense_shape=tuple(dense_grad.shape))

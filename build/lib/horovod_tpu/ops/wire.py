@@ -1,0 +1,211 @@
+"""Control-message wire format.
+
+TPU-native re-design of the reference's flatbuffers control-message layer
+(horovod/common/mpi_message.{h,cc} + wire/mpi_message.fbs).  The reference
+serializes worker→coordinator ``MPIRequest`` and coordinator→worker
+``MPIResponse`` messages with flatbuffers; we use a hand-rolled
+little-endian binary layout (packed here and parsed identically by
+native/wire.cc) because the messages are tiny, fixed-field, and the control
+plane only runs on the *dynamic* path (eager ops, variable-size allgather,
+error negotiation) — the static pjit path needs no control messages at all.
+
+Field-for-field parity with the reference schema:
+  Request  ≙ MPIRequest  (mpi_message.h:43-85): request_rank, request_type,
+             tensor_type, tensor_name, root_rank, device, tensor_shape.
+  Response ≙ MPIResponse (mpi_message.h:112-157): response_type (incl.
+             ERROR/DONE/SHUTDOWN), fused tensor_names, error_message,
+             devices, tensor_sizes (allgather dim-0 per rank).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class DataType(IntEnum):
+    """Mirrors MPIDataType (mpi_message.h:26-36) plus TPU-first additions:
+    bfloat16 is the native TPU matmul dtype and float16 completes the
+    half-precision pair."""
+
+    UINT8 = 0
+    INT8 = 1
+    UINT16 = 2
+    INT16 = 3
+    INT32 = 4
+    INT64 = 5
+    FLOAT32 = 6
+    FLOAT64 = 7
+    BOOL = 8
+    BFLOAT16 = 9
+    FLOAT16 = 10
+
+
+_NP_TO_DTYPE = {
+    np.dtype(np.uint8): DataType.UINT8,
+    np.dtype(np.int8): DataType.INT8,
+    np.dtype(np.uint16): DataType.UINT16,
+    np.dtype(np.int16): DataType.INT16,
+    np.dtype(np.int32): DataType.INT32,
+    np.dtype(np.int64): DataType.INT64,
+    np.dtype(np.float32): DataType.FLOAT32,
+    np.dtype(np.float64): DataType.FLOAT64,
+    np.dtype(np.bool_): DataType.BOOL,
+    np.dtype(np.float16): DataType.FLOAT16,
+}
+
+_DTYPE_SIZE = {
+    DataType.UINT8: 1, DataType.INT8: 1, DataType.UINT16: 2,
+    DataType.INT16: 2, DataType.INT32: 4, DataType.INT64: 8,
+    DataType.FLOAT32: 4, DataType.FLOAT64: 8, DataType.BOOL: 1,
+    DataType.BFLOAT16: 2, DataType.FLOAT16: 2,
+}
+
+
+def dtype_of(array_dtype) -> DataType:
+    """np/jnp dtype → wire DataType (≙ GetMPIDataType table,
+    operations.cc:463-487)."""
+    d = np.dtype(array_dtype) if not str(array_dtype) == "bfloat16" else None
+    if d is not None and d in _NP_TO_DTYPE:
+        return _NP_TO_DTYPE[d]
+    if str(array_dtype) == "bfloat16":
+        return DataType.BFLOAT16
+    raise ValueError(f"Unsupported dtype for horovod_tpu collective: {array_dtype}")
+
+
+def dtype_name(dt: DataType) -> str:
+    return DataType(dt).name.lower()
+
+
+def dtype_size(dt: DataType) -> int:
+    return _DTYPE_SIZE[DataType(dt)]
+
+
+class RequestType(IntEnum):
+    """≙ MPIRequestType (mpi_message.h)."""
+
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+
+
+class ResponseType(IntEnum):
+    """≙ MPIResponseType (mpi_message.h) — ERROR carries a cross-replica
+    validation message; DONE/SHUTDOWN close the negotiation."""
+
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    ERROR = 3
+    DONE = 4
+    SHUTDOWN = 5
+
+
+# Device id of a host-resident tensor (≙ CPU_DEVICE_ID, common.h:28).
+CPU_DEVICE_ID = -1
+
+
+@dataclass
+class Request:
+    request_rank: int
+    request_type: RequestType
+    tensor_type: DataType
+    tensor_name: str
+    root_rank: int = -1
+    device: int = CPU_DEVICE_ID
+    tensor_shape: Tuple[int, ...] = ()
+
+    def pack(self) -> bytes:
+        name_b = self.tensor_name.encode("utf-8")
+        out = struct.pack(
+            "<BBiii H", int(self.request_type), int(self.tensor_type),
+            self.request_rank, self.root_rank, self.device, len(name_b))
+        out += name_b
+        out += struct.pack("<B", len(self.tensor_shape))
+        for d in self.tensor_shape:
+            out += struct.pack("<q", d)
+        return out
+
+    @staticmethod
+    def unpack(buf: bytes, off: int = 0) -> Tuple["Request", int]:
+        rt, tt, rank, root, dev, nlen = struct.unpack_from("<BBiii H", buf, off)
+        off += struct.calcsize("<BBiii H")
+        name = buf[off:off + nlen].decode("utf-8")
+        off += nlen
+        (ndim,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        dims = struct.unpack_from(f"<{ndim}q", buf, off) if ndim else ()
+        off += 8 * ndim
+        return Request(rank, RequestType(rt), DataType(tt), name, root, dev,
+                       tuple(dims)), off
+
+
+@dataclass
+class Response:
+    response_type: ResponseType
+    tensor_names: List[str] = field(default_factory=list)
+    error_message: str = ""
+    devices: List[int] = field(default_factory=list)
+    # For ALLGATHER: dim-0 extent contributed by each replica, in rank order
+    # (ordering guarantee ≙ mpi_message.h:48-51).
+    tensor_sizes: List[int] = field(default_factory=list)
+
+    def pack(self) -> bytes:
+        out = struct.pack("<BH", int(self.response_type), len(self.tensor_names))
+        for n in self.tensor_names:
+            nb = n.encode("utf-8")
+            out += struct.pack("<H", len(nb)) + nb
+        eb = self.error_message.encode("utf-8")
+        out += struct.pack("<I", len(eb)) + eb
+        out += struct.pack("<H", len(self.devices))
+        for d in self.devices:
+            out += struct.pack("<i", d)
+        out += struct.pack("<H", len(self.tensor_sizes))
+        for s in self.tensor_sizes:
+            out += struct.pack("<q", s)
+        return out
+
+    @staticmethod
+    def unpack(buf: bytes, off: int = 0) -> Tuple["Response", int]:
+        rt, nnames = struct.unpack_from("<BH", buf, off)
+        off += struct.calcsize("<BH")
+        names = []
+        for _ in range(nnames):
+            (ln,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            names.append(buf[off:off + ln].decode("utf-8"))
+            off += ln
+        (elen,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        err = buf[off:off + elen].decode("utf-8")
+        off += elen
+        (ndev,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        devices = list(struct.unpack_from(f"<{ndev}i", buf, off)) if ndev else []
+        off += 4 * ndev
+        (nsz,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        sizes = list(struct.unpack_from(f"<{nsz}q", buf, off)) if nsz else []
+        off += 8 * nsz
+        return Response(ResponseType(rt), names, err, devices, sizes), off
+
+
+def pack_response_list(responses: List[Response]) -> bytes:
+    out = struct.pack("<H", len(responses))
+    for r in responses:
+        out += r.pack()
+    return out
+
+
+def unpack_response_list(buf: bytes) -> List[Response]:
+    (n,) = struct.unpack_from("<H", buf, 0)
+    off = 2
+    out = []
+    for _ in range(n):
+        r, off = Response.unpack(buf, off)
+        out.append(r)
+    return out

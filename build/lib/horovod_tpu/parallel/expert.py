@@ -1,0 +1,168 @@
+"""Expert parallelism: Mixture-of-Experts with all_to_all token routing.
+
+Beyond-parity extension (SURVEY.md §2.3 "Expert parallelism: NO").  The
+design is the standard Switch/GShard formulation mapped onto a mesh axis:
+
+* every device holds ``num_experts / axis_size`` expert MLPs,
+* a router picks top-k experts per token with a capacity limit,
+* tokens are dispatched to their experts with ONE ``all_to_all`` (the
+  ICI-native equivalent of the reference's point-to-point sends — there
+  are none in the reference; MPI_Alltoall would be the analogue),
+* expert outputs return with a second ``all_to_all`` and are combined by
+  router weight.
+
+Everything is dense einsums over static shapes (dispatch/combine one-hot
+tensors), so XLA tiles it onto the MXU and overlaps the two collectives —
+no scalar gather/scatter loops.
+
+Conventionally EP rides the *data* axis (expert groups = DP groups):
+pass ``axis_name="data"``; a dedicated ``expert`` axis works identically.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class MoEOutput(NamedTuple):
+    out: jnp.ndarray          # [tokens, d_model]
+    aux_loss: jnp.ndarray     # scalar load-balancing loss
+    dropped_fraction: jnp.ndarray  # scalar, tokens beyond capacity
+
+
+def init_moe_params(key, num_experts: int, d_model: int, d_hidden: int,
+                    dtype=jnp.float32) -> dict:
+    """Full (unsharded) expert stack + router; shard the leading expert
+    axis over the EP mesh axis before use (or index with
+    :func:`local_experts`)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = d_model ** -0.5
+    scale_out = d_hidden ** -0.5
+    return {
+        "router": jax.random.normal(k1, (d_model, num_experts),
+                                    dtype) * scale_in,
+        "w_in": jax.random.normal(k2, (num_experts, d_model, d_hidden),
+                                  dtype) * scale_in,
+        "w_out": jax.random.normal(k3, (num_experts, d_hidden, d_model),
+                                   dtype) * scale_out,
+    }
+
+
+def local_experts(params: dict, *, axis_name: str) -> dict:
+    """Slice this device's expert shard (inside shard_map) from replicated
+    full params; the router stays replicated."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    def shard(leaf):
+        size = leaf.shape[0] // n
+        return jax.lax.dynamic_slice_in_dim(leaf, idx * size, size, axis=0)
+
+    return {"router": params["router"],
+            "w_in": shard(params["w_in"]),
+            "w_out": shard(params["w_out"])}
+
+
+def _top_k_dispatch(probs, k: int, capacity: int):
+    """Greedy top-k routing with per-expert capacity.
+
+    Returns dispatch ``[t, E, C]`` (0/1) and combine ``[t, E, C]``
+    (gate-weighted) tensors, plus the dropped-token fraction.
+    """
+    tokens, num_experts = probs.shape
+    remaining = probs
+    dispatch = jnp.zeros((tokens, num_experts, capacity), probs.dtype)
+    combine = jnp.zeros((tokens, num_experts, capacity), probs.dtype)
+    # Tokens already admitted per expert (running fill count).
+    fill = jnp.zeros((num_experts,), jnp.int32)
+    routed = jnp.zeros((tokens,), probs.dtype)
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)              # [t]
+        gate = jnp.take_along_axis(remaining, choice[:, None],
+                                   axis=-1)[:, 0]            # [t]
+        onehot = jax.nn.one_hot(choice, num_experts,
+                                dtype=probs.dtype)           # [t, E]
+        # Position of each token within its chosen expert's buffer:
+        # earlier tokens first (cumsum order), offset by the current fill.
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0
+               + fill[None, :].astype(probs.dtype))          # [t, E]
+        pos_tok = jnp.sum(pos * onehot, axis=-1)             # [t]
+        keep = pos_tok < capacity
+        pos_oh = jax.nn.one_hot(
+            jnp.clip(pos_tok, 0, capacity - 1).astype(jnp.int32),
+            capacity, dtype=probs.dtype)                     # [t, C]
+        d = (onehot * keep[:, None].astype(probs.dtype))[:, :, None] \
+            * pos_oh[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        fill = fill + jnp.sum(
+            onehot * keep[:, None].astype(probs.dtype),
+            axis=0).astype(jnp.int32)
+        routed = routed + keep.astype(probs.dtype)
+        # Exclude the chosen expert from the next round.
+        remaining = remaining * (1.0 - onehot)
+    dropped = 1.0 - jnp.mean(routed) / k
+    return dispatch, combine, dropped
+
+
+def moe_layer(x, params: dict, *, axis_name: str, num_experts: int,
+              top_k: int = 2, capacity_factor: float = 1.25,
+              activation=jax.nn.gelu,
+              aux_loss_weight: float = 1e-2) -> MoEOutput:
+    """Sharded mixture-of-experts FFN (inside shard_map over
+    ``axis_name``).
+
+    Args:
+      x: ``[tokens_local, d_model]`` — this shard's tokens.
+      params: ``router [d, E]`` (replicated), ``w_in [E_local, d, h]``,
+        ``w_out [E_local, h, d]`` — expert leading axes already sharded
+        (e.g. via :func:`local_experts`).
+      num_experts: global expert count E (must divide by the axis size).
+    """
+    n = jax.lax.axis_size(axis_name)
+    tokens, d_model = x.shape
+    e_local = num_experts // n
+    if e_local * n != num_experts:
+        raise ValueError(f"num_experts ({num_experts}) must divide by the "
+                         f"'{axis_name}' axis size ({n})")
+    if params["w_in"].shape[0] != e_local:
+        raise ValueError(
+            f"params carry {params['w_in'].shape[0]} local experts but "
+            f"num_experts/axis_size = {e_local}; shard them with "
+            f"local_experts() first")
+    capacity = max(1, int(tokens * capacity_factor * top_k / num_experts))
+
+    logits = jnp.dot(x, params["router"],
+                     preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, dropped = _top_k_dispatch(probs, top_k, capacity)
+
+    # Load-balancing auxiliary loss (Switch Transformer eq. 4): fraction
+    # of tokens per expert × mean router probability per expert.
+    token_frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=0)
+    prob_frac = jnp.mean(probs, axis=0)
+    aux = aux_loss_weight * num_experts * jnp.sum(
+        token_frac * prob_frac)
+
+    # Dispatch: [t, d] x [t, E, C] -> [E, C, d]; ship each device its
+    # experts' buffers from every peer.
+    expert_in = jnp.einsum("td,tec->ecd", x.astype(jnp.float32),
+                           dispatch.astype(jnp.float32))
+    expert_in = jax.lax.all_to_all(expert_in, axis_name, split_axis=0,
+                                   concat_axis=1, tiled=True)
+    # -> [E_local, n*C, d]: run the local experts on everyone's tokens.
+    h = jnp.einsum("ecd,edh->ech", expert_in,
+                   params["w_in"].astype(jnp.float32))
+    h = activation(h)
+    expert_out = jnp.einsum("ech,ehd->ecd", h,
+                            params["w_out"].astype(jnp.float32))
+    # Return trip and weighted combine.
+    expert_out = jax.lax.all_to_all(expert_out, axis_name, split_axis=1,
+                                    concat_axis=0, tiled=True)
+    out = jnp.einsum("ecd,tec->td", expert_out,
+                     combine.astype(jnp.float32))
+    return MoEOutput(out.astype(x.dtype), aux.astype(jnp.float32),
+                     dropped.astype(jnp.float32))

@@ -1,0 +1,101 @@
+"""Pipeline parallelism: GPipe-style microbatching over a mesh axis.
+
+Beyond-parity extension (SURVEY.md §2.3 "Pipeline parallelism: NO").
+Layer blocks shard over :data:`..core.topology.PIPE_AXIS`; a batch is cut
+into microbatches that flow stage-to-stage over ICI via ``lax.ppermute``
+inside a ``lax.scan`` — the whole schedule is one compiled XLA program, so
+the backward pass (reverse scan, reversed permutes) is derived by JAX AD
+and is itself pipelined.  Bubble fraction is the usual
+``(n_stages - 1) / (n_microbatches + n_stages - 1)``.
+
+Use inside ``shard_map``: every device holds *its stage's* parameters
+(same pytree structure, different values) and calls :func:`gpipe` on the
+(replicated) batch.  Stage functions must preserve the activation
+shape — the natural fit is a stack of identical transformer blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core.topology import PIPE_AXIS
+
+
+def gpipe(stage_fn: Callable, stage_params, x, *, num_microbatches: int,
+          axis_name: str = PIPE_AXIS):
+    """Run ``x`` through ``n_stages`` pipelined applications of
+    ``stage_fn``.
+
+    Args:
+      stage_fn: ``stage_fn(stage_params, x_mb) -> y_mb`` (shape-
+        preserving).  Called by every device on its own stage's params.
+      stage_params: this device's stage parameters (from shard_map over
+        the pipe axis).
+      x: the full per-pipeline batch ``[batch, ...]`` (replicated across
+        the pipe axis); ``batch`` must divide by ``num_microbatches``.
+      num_microbatches: pipeline depth-filling factor.
+
+    Returns:
+      ``y`` with the same shape as ``x``, valid on every stage (the last
+      stage's results are summed across the axis, other stages contribute
+      zeros — one psum at the end).
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    m = num_microbatches
+    if x.shape[0] % m != 0:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"num_microbatches {m}")
+    mb = x.shape[0] // m
+    xs = x.reshape((m, mb) + x.shape[1:])
+    # send i -> i+1 (last stage's send is dropped into stage 0, ignored)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        recv, outs = carry
+        # Stage 0 draws the next microbatch from the batch; later stages
+        # consume what arrived from the left neighbor.
+        mb_idx = jnp.clip(t, 0, m - 1)
+        first_in = jax.lax.dynamic_index_in_dim(xs, mb_idx, keepdims=False)
+        x_in = jnp.where(idx == 0, first_in, recv)
+        y = stage_fn(stage_params, x_in)
+        # The last stage finished microbatch t - (n - 1) this tick.
+        out_idx = t - (n - 1)
+        valid = jnp.logical_and(idx == n - 1, out_idx >= 0)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs,
+            jnp.where(valid, y,
+                      jax.lax.dynamic_index_in_dim(
+                          outs, jnp.clip(out_idx, 0, m - 1),
+                          keepdims=False)),
+            jnp.clip(out_idx, 0, m - 1), axis=0)
+        recv = jax.lax.ppermute(y, axis_name, perm)
+        return (recv, outs), None
+
+    ticks = jnp.arange(m + n - 1)
+    recv0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    outs0 = jnp.zeros_like(xs)
+    (_, outs), _ = jax.lax.scan(tick, (recv0, outs0), ticks)
+    # Only the last stage holds real outputs; share them with one psum.
+    outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
+    outs = jax.lax.psum(outs, axis_name)
+    return outs.reshape(x.shape)
+
+
+def stage_index(axis_name: str = PIPE_AXIS):
+    """This device's pipeline stage id (inside shard_map)."""
+    return jax.lax.axis_index(axis_name)
+
+
+def select_stage_params(params_per_stage, *, axis_name: str = PIPE_AXIS):
+    """Slice one stage's parameters out of a stacked
+    ``[n_stages, ...]``-leading pytree (inside shard_map, replicated
+    input)."""
+    idx = jax.lax.axis_index(axis_name)
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.lax.dynamic_index_in_dim(leaf, idx,
+                                                  keepdims=False),
+        params_per_stage)

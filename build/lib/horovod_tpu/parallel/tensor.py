@@ -1,0 +1,86 @@
+"""Tensor (model) parallelism: Megatron-style sharded matmuls.
+
+Beyond-parity extension (the reference shards nothing — SURVEY.md §2.3
+"Tensor parallelism: NO").  Weight matrices shard over the
+:data:`..core.topology.MODEL_AXIS` mesh axis; activations stay replicated
+within a model group.  The classic pairing keeps communication to one
+``psum`` per block:
+
+* :func:`column_parallel` — weight split on the *output* feature axis;
+  each device computes a disjoint slice of the outputs.  No communication
+  (outputs stay sharded), so it starts a block.
+* :func:`row_parallel` — weight split on the *input* feature axis; each
+  device contracts its input slice and the partial products are summed
+  with ``lax.psum``.  It ends a block, consuming column-parallel outputs
+  directly.
+
+``tp_mlp`` composes them into the standard 2-layer block (one collective
+per MLP); attention uses column-parallel QKV (heads sharded) + row-
+parallel output projection the same way — see models/transformer.py.
+
+All functions are for use inside ``shard_map`` over a mesh that has the
+model axis.  Helpers to place full weights shard-wise live here too.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.topology import MODEL_AXIS
+
+
+def column_parallel(x, w, b=None, *, axis_name: str = MODEL_AXIS,
+                    gather_output: bool = False):
+    """``y_local = x @ w_local (+ b_local)`` with ``w`` sharded on its
+    last (output) axis.  Outputs are feature-sharded unless
+    ``gather_output``.
+    """
+    y = jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    if b is not None:
+        y = y + b
+    if gather_output:
+        y = jax.lax.all_gather(y, axis_name, axis=y.ndim - 1, tiled=True)
+    return y
+
+
+def row_parallel(x, w, b=None, *, axis_name: str = MODEL_AXIS,
+                 input_is_parallel: bool = True):
+    """``y = psum_axis(x_local @ w_local) (+ b)`` with ``w`` sharded on its
+    first (input) axis.
+
+    ``input_is_parallel=True`` (the default) means ``x`` is already
+    feature-sharded — i.e. it came from :func:`column_parallel`; otherwise
+    the local input slice is taken here.
+    """
+    if not input_is_parallel:
+        n = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        shard = x.shape[-1] // n
+        x = jax.lax.dynamic_slice_in_dim(x, idx * shard, shard, axis=-1)
+    y = jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    y = jax.lax.psum(y, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp(x, w_in, b_in, w_out, b_out, *, axis_name: str = MODEL_AXIS,
+           activation=jax.nn.gelu):
+    """The Megatron MLP block: column-parallel up-projection, elementwise
+    activation on the sharded features, row-parallel down-projection.
+    Exactly one ``psum`` of communication."""
+    h = column_parallel(x, w_in, b_in, axis_name=axis_name)
+    h = activation(h)
+    return row_parallel(h, w_out, b_out, axis_name=axis_name)
+
+
+def local_shard(full, dim: int, *, axis_name: str = MODEL_AXIS):
+    """``full``'s shard for the calling device along ``dim`` (inside
+    shard_map)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    size = full.shape[dim] // n
+    return jax.lax.dynamic_slice_in_dim(full, idx * size, size, axis=dim)

@@ -1,0 +1,88 @@
+"""Checkpoint/resume with the reference's rank-0 + broadcast conventions.
+
+The reference delegates serialization to the frameworks but fixes two
+conventions (SURVEY.md §5): save on rank 0 only (README.md:102-104,
+examples/keras_imagenet_resnet50.py:126-127) and, on resume, load on rank 0
+then broadcast — including the scalar ``resume_from_epoch``
+(examples/keras_imagenet_resnet50.py:47-56, :130-133).
+
+Serialization uses flax msgpack (``flax.serialization``) — a single
+self-contained file, atomic-renamed into place.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ..core import state as _state
+from ..parallel.data import broadcast_parameters
+
+
+def _is_saving_process() -> bool:
+    return _state.process_index() == 0
+
+
+def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> bool:
+    """Save ``tree`` at ``path`` from the coordinating process only
+    (≙ the rank-0 guard in every reference example).  Returns True if this
+    process performed the save."""
+    if not _is_saving_process():
+        return False
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    from flax import serialization
+
+    host_tree = jax.tree_util.tree_map(np.asarray, tree)
+    blob = serialization.to_bytes(host_tree)
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)  # atomic publish
+    if step is not None:
+        with open(f"{path}.step", "w") as f:
+            f.write(str(step))
+    return True
+
+
+def restore_checkpoint(path: str, target: Any, broadcast: bool = True) -> Any:
+    """Load ``path`` into the structure of ``target`` and (by default)
+    broadcast from root so all replicas resume identically
+    (≙ load-on-rank-0-then-broadcast, keras_imagenet_resnet50.py:130-133).
+
+    Only the coordinating process reads the file — non-root processes keep
+    ``target`` and receive root's values through the broadcast, so a
+    checkpoint that exists only on the coordinator's disk restores
+    everywhere (the reference's save-on-rank-0 convention implies exactly
+    this asymmetry)."""
+    from flax import serialization
+
+    if not _state.is_initialized() or _is_saving_process():
+        with open(path, "rb") as f:
+            blob = f.read()
+        tree = serialization.from_bytes(target, blob)
+    else:
+        tree = target
+    if broadcast and _state.is_initialized():
+        tree = broadcast_parameters(tree, root_rank=0)
+    return tree
+
+
+def resume_epoch(path: str) -> int:
+    """Determine the epoch to resume from and agree on it across replicas —
+    the reference broadcasts this scalar explicitly
+    (keras_imagenet_resnet50.py:47-56)."""
+    epoch = 0
+    step_file = f"{path}.step"
+    if os.path.exists(step_file):
+        with open(step_file) as f:
+            epoch = int(f.read().strip())
+    if _state.is_initialized():
+        from ..ops import collective as C
+
+        epoch = int(np.asarray(C.broadcast(
+            np.asarray(epoch, np.int32), root_rank=0,
+            name="resume_from_epoch")))
+    return epoch

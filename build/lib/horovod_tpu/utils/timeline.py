@@ -1,0 +1,151 @@
+"""Chrome-tracing timeline.
+
+TPU-native equivalent of the reference's Horovod Timeline
+(horovod/common/timeline.{h,cc}): a ``chrome://tracing``-loadable JSON file
+written when ``HOROVOD_TIMELINE=<path>`` is set, on the coordinating process
+only (reference: operations.cc:1201-1204).  Per-tensor lifecycle follows the
+same state machine UNKNOWN → NEGOTIATING → TOP_LEVEL → ACTIVITY
+(timeline.h:34) and tensors are modeled as trace "processes" with pid
+metadata so each gets its own row (timeline.cc:59-76).
+
+Activity names are mapped from the reference's MPI/CUDA phases
+(docs/timeline.md) to their XLA analogues:
+
+  NEGOTIATE_*          — dynamic-path negotiation (unchanged)
+  QUEUE                — host-side enqueue until XLA dispatch
+  MEMCPY_IN_FUSION_BUFFER / MEMCPY_OUT_FUSION_BUFFER
+                       — flatten/concat into and out of a fusion bucket
+  XLA_ALLREDUCE / XLA_ALLGATHER / XLA_BCAST
+                       — the compiled collective (≙ MPI_ALLREDUCE /
+                         NCCL_ALLREDUCE etc.)
+  WAIT_FOR_DATA        — host blocking on device completion
+
+When the native library is built, event formatting/flushing runs in C++
+(native/timeline.cc, ≙ common/timeline.cc); this class is the fallback and
+the interface both share.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from ..native import lib as _native
+
+# Flush cadence, seconds (≙ TIMELINE_FLUSH_TIME, timeline.h:32).
+_FLUSH_SECONDS = 1.0
+
+# Event phase chars of the Chrome trace format.
+_PH_METADATA = "M"
+_PH_BEGIN = "B"
+_PH_END = "E"
+_PH_INSTANT = "i"
+
+
+class Timeline:
+    def __init__(self, path: str):
+        self._path = path
+        self._lock = threading.Lock()
+        self._native = None
+        if _native.NATIVE and hasattr(_native.raw(), "hvd_timeline_create"):
+            self._native = _native.raw().hvd_timeline_create(path.encode())
+        self._file = None
+        self._tensor_pids = {}
+        self._next_pid = 1
+        self._start = time.monotonic()
+        self._last_flush = self._start
+        if self._native is None:
+            self._file = open(path, "w")
+            self._file.write("[\n")
+
+    # -- low-level ---------------------------------------------------------
+    def _ts_us(self) -> float:
+        return (time.monotonic() - self._start) * 1e6
+
+    def _pid_locked(self, tensor: str) -> int:
+        pid = self._tensor_pids.get(tensor)
+        if pid is None:
+            pid = self._next_pid
+            self._next_pid += 1
+            self._tensor_pids[tensor] = pid
+            # Name the "process" row after the tensor (timeline.cc:59-76).
+            self._emit_locked({"name": "process_name", "ph": _PH_METADATA,
+                               "pid": pid, "args": {"name": tensor}})
+            self._emit_locked({"name": "process_sort_index",
+                               "ph": _PH_METADATA, "pid": pid,
+                               "args": {"sort_index": pid}})
+        return pid
+
+    def _emit_locked(self, ev: dict) -> None:
+        if self._file is None:
+            return
+        self._file.write(json.dumps(ev) + ",\n")
+        now = time.monotonic()
+        if now - self._last_flush > _FLUSH_SECONDS:
+            self._file.flush()
+            self._last_flush = now
+
+    def _event(self, ph: str, tensor: str, name: str = "",
+               args: Optional[dict] = None) -> None:
+        if self._native is not None:
+            _native.raw().hvd_timeline_event(
+                self._native,
+                {"B": 0, "E": 1, "i": 2, "M": 3}[ph],
+                tensor.encode(), name.encode(),
+                json.dumps(args or {}).encode(), 0.0)
+            return
+        with self._lock:
+            ev = {"ph": ph, "ts": self._ts_us(),
+                  "pid": self._pid_locked(tensor)}
+            if name:
+                ev["name"] = name
+            if args:
+                ev["args"] = args
+            self._emit_locked(ev)
+
+    # -- negotiation phase (timeline.cc:106-134) ---------------------------
+    def negotiate_start(self, tensor: str, op_name: str) -> None:
+        self._event(_PH_BEGIN, tensor, f"NEGOTIATE_{op_name.upper()}")
+
+    def negotiate_rank_ready(self, tensor: str, rank: int,
+                             first: bool = False) -> None:
+        self._event(_PH_INSTANT, tensor, str(rank))
+
+    def negotiate_end(self, tensor: str) -> None:
+        self._event(_PH_END, tensor)
+
+    # -- top-level + activities (timeline.cc:136-182) ----------------------
+    def start(self, tensor: str, op_name: str, args: Optional[dict] = None
+              ) -> None:
+        self._event(_PH_BEGIN, tensor, op_name.upper(), args)
+
+    def activity_start(self, tensor: str, activity: str) -> None:
+        self._event(_PH_BEGIN, tensor, activity)
+
+    def activity_end(self, tensor: str) -> None:
+        self._event(_PH_END, tensor)
+
+    def end(self, tensor: str, dtype: str = "", shape: str = "") -> None:
+        args = {}
+        if dtype:
+            args["dtype"] = dtype
+        if shape:
+            args["shape"] = shape
+        self._event(_PH_END, tensor, args=args or None)
+
+    def close(self) -> None:
+        if self._native is not None:
+            _native.raw().hvd_timeline_close(self._native)
+            self._native = None
+            return
+        with self._lock:
+            if self._file is not None:
+                # Chrome tracing tolerates a trailing comma / missing "]",
+                # but emit a valid JSON array anyway.
+                self._file.write(json.dumps(
+                    {"ph": _PH_INSTANT, "ts": self._ts_us(), "pid": 0,
+                     "name": "shutdown"}) + "\n]\n")
+                self._file.close()
+                self._file = None
