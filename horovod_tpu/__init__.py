@@ -22,6 +22,8 @@ reference horovod/tensorflow/__init__.py, horovod/torch/__init__.py):
 from .core.state import (  # noqa: F401
     REPLICA_AXIS,
     NotInitializedError,
+    cross_rank,
+    cross_size,
     init,
     is_initialized,
     local_rank,

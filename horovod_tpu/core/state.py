@@ -75,6 +75,13 @@ class _GlobalState:
     local_size: int = 0
     process_index: int = 0
     process_count: int = 1
+    # Multi-process mode (reference: N MPI ranks): True when this runtime
+    # spans several jax processes under jax.distributed.
+    multiprocess: bool = False
+    # Cross-process control-plane transport (ops.transport.*Transport).
+    transport: Any = None
+    # Node-level placement (ops.transport.Topology) in multi-process mode.
+    topology: Any = None
     # Tensor-fusion threshold in bytes (reference default 64 MB,
     # operations.cc:140, env HOROVOD_FUSION_THRESHOLD).
     fusion_threshold_bytes: int = 64 * 1024 * 1024
@@ -124,13 +131,25 @@ def init(devices=None) -> None:
         # Re-init with a different replica set: tear down the old runtime
         # (background thread, coordinator, timeline) first.
         shutdown()
+    # Bootstrap the process cluster BEFORE the first device enumeration
+    # (≙ MPI_Init_thread before MPI_Comm_rank, operations.cc:1173-1181).
+    from . import cluster as _cluster
+
+    spec = _cluster.maybe_initialize()
     with _state.lock:
+        _state.process_index = jax.process_index()
+        _state.process_count = jax.process_count()
+        _state.multiprocess = _state.process_count > 1
+        if _state.multiprocess and devices is not None:
+            raise ValueError(
+                "init(devices=...) subsets are single-process only; in "
+                "multi-process mode every process must use the full global "
+                "topology (the reference likewise fixes the communicator "
+                "at MPI_COMM_WORLD).")
         devs = tuple(devices if devices is not None else jax.devices())
         _state.devices = devs
         _state.mesh = _build_mesh(devs)
         _state.size = len(devs)
-        _state.process_index = jax.process_index()
-        _state.process_count = jax.process_count()
         if devices is not None:
             local = [d for d in devs if d.process_index == _state.process_index]
             _state.local_size = len(local) if local else len(devs)
@@ -158,11 +177,39 @@ def init(devices=None) -> None:
 
         from ..ops.coordinator import Coordinator
 
-        _state.coordinator = Coordinator(
-            size=_state.size,
-            fusion_threshold=_state.fusion_threshold_bytes,
-            timeline=_state.timeline,
-        )
+        if _state.multiprocess:
+            # Reference topology: negotiation runs at process (MPI-rank)
+            # granularity, with rank 0 as the coordinator and a TCP control
+            # plane carrying the wire messages (≙ operations.cc:1226-1374).
+            from ..ops import transport as _transport
+
+            if spec is None:
+                raise RuntimeError(
+                    "jax.distributed is active but no HVD_TPU_COORDINATOR/"
+                    "JAX_COORDINATOR_ADDRESS is visible; the eager control "
+                    "plane needs it to locate the rank-0 controller.")
+            if _state.process_index == 0:
+                _state.coordinator = Coordinator(
+                    size=_state.process_count,
+                    fusion_threshold=_state.fusion_threshold_bytes,
+                    timeline=_state.timeline,
+                )
+                _state.transport = _transport.ControllerTransport(
+                    _state.coordinator, _state.process_count,
+                    spec.controller_port)
+                _state.topology = _state.transport.topology[0]
+            else:
+                _state.coordinator = None
+                _state.transport = _transport.WorkerTransport(
+                    spec.controller_host, spec.controller_port,
+                    _state.process_index)
+                _state.topology = _state.transport.topology
+        else:
+            _state.coordinator = Coordinator(
+                size=_state.size,
+                fusion_threshold=_state.fusion_threshold_bytes,
+                timeline=_state.timeline,
+            )
 
         # Spawn the background tick thread serving async eager collectives
         # (≙ InitializeHorovodOnce spawning BackgroundThreadLoop,
@@ -194,9 +241,14 @@ def shutdown() -> None:
         if _state.timeline is not None:
             _state.timeline.close()
             _state.timeline = None
+        if _state.transport is not None:
+            _state.transport.close()
+            _state.transport = None
         if _state.coordinator is not None:
             _state.coordinator.close()
             _state.coordinator = None
+        _state.topology = None
+        _state.multiprocess = False
         _state.shutdown = True
         _state.initialized = False
 
@@ -211,38 +263,73 @@ def is_initialized() -> bool:
 
 
 def size() -> int:
-    """Global replica (device) count — the gradient-averaging denominator.
+    """Global replica (device) count.
 
     Reference: ``horovod_size`` (operations.cc:1511-1515) returns the
     MPI_COMM_WORLD size; here the replica mesh extent plays that role.
+    NOTE: eager collectives average over :func:`contributor_count` (==
+    ``size()`` single-process, ``process_count()`` multi-process, where
+    each process contributes one tensor like an MPI rank).
     """
     _check_initialized()
     return _state.size
 
 
-def local_size() -> int:
-    """Replicas owned by this process (reference: horovod_local_size,
-    operations.cc:1523-1527, via MPI_Comm_split_type(SHARED))."""
+def contributor_count() -> int:
+    """Number of independent contributions to an eager collective — the
+    ``average=True`` denominator.  Multi-process mode: one per process
+    (the reference's one-tensor-per-MPI-rank model).  Single-process: one
+    per replica (the ``shard()`` layout)."""
     _check_initialized()
+    return _state.process_count if _state.multiprocess else _state.size
+
+
+def local_size() -> int:
+    """Multi-process mode: processes sharing this node (reference:
+    horovod_local_size, operations.cc:1523-1527, via
+    MPI_Comm_split_type(SHARED), computed here from the hostname exchange
+    on the control plane).  Single-process: replicas owned by this
+    process."""
+    _check_initialized()
+    if _state.multiprocess:
+        return _state.topology.local_size
     return _state.local_size
 
 
 def rank() -> int:
-    """Host-level rank: first replica owned by this process.
-
-    Equals the Horovod rank exactly in one-device-per-process mode
-    (reference: horovod_rank, operations.cc:1505-1509).  Per-replica code
-    should use ``replica_id()`` instead.
-    """
+    """Multi-process mode: this process's global rank — exact reference
+    semantics (horovod_rank, operations.cc:1505-1509).  Single-process:
+    first replica owned by this process.  Per-replica code inside traced
+    functions should use ``replica_id()`` instead."""
     _check_initialized()
+    if _state.multiprocess:
+        return _state.process_index
     return _state.process_index * _state.local_size
 
 
 def local_rank() -> int:
-    """Host-level local rank (reference: horovod_local_rank,
-    operations.cc:1517-1521).  0 for the controller process."""
+    """Multi-process mode: rank within this node (reference:
+    horovod_local_rank, operations.cc:1517-1521).  Single-process: 0."""
     _check_initialized()
+    if _state.multiprocess:
+        return _state.topology.local_rank
     return 0
+
+
+def cross_rank() -> int:
+    """This node's index among all nodes (one representative per node)."""
+    _check_initialized()
+    if _state.multiprocess:
+        return _state.topology.cross_rank
+    return 0
+
+
+def cross_size() -> int:
+    """Number of distinct nodes in the job."""
+    _check_initialized()
+    if _state.multiprocess:
+        return _state.topology.cross_size
+    return 1
 
 
 def process_index() -> int:

@@ -43,8 +43,10 @@ from .wire import (DataType, Request, RequestType, Response, ResponseType)
 from ..native import lib as _native
 
 # Seconds a tensor may sit in negotiation before a stall warning
-# (≙ STALL_WARNING_TIME, operations.cc:208).
-STALL_WARNING_SECONDS = 60.0
+# (≙ STALL_WARNING_TIME, operations.cc:208).  Env-tunable so tests and
+# impatient deployments can tighten the watchdog.
+STALL_WARNING_SECONDS = float(
+    os.environ.get("HOROVOD_STALL_WARNING_SECONDS", "60"))
 
 
 @dataclass

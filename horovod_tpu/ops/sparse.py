@@ -61,7 +61,7 @@ def allreduce(slices, average: bool = True, name: Optional[str] = None):
                               name=f"{name}.indices")
         dense_shape = per[0].dense_shape
     if average:
-        values = values / _state.size()
+        values = values / _state.contributor_count()
     return IndexedSlices(values=values, indices=indices,
                          dense_shape=dense_shape)
 

@@ -1,0 +1,280 @@
+"""Cross-process control-plane transport for eager collectives.
+
+Reference architecture (horovod/common/operations.cc:1226-1374): rank 0 is
+the coordinator; every worker ships its ``MPIRequest`` messages to it
+(MPI_Gather of lengths + MPI_Gatherv of payloads) and receives the fused
+``MPIResponse`` list back (MPI_Bcast), after which all ranks execute the
+responses in the identical broadcast order.  This module keeps that exact
+message flow over one TCP connection per worker, speaking the same binary
+wire format the in-process coordinator already uses (ops/wire.py — which
+existed precisely to move Request/Response between processes).
+
+The connection doubles as the node-topology rendezvous: each worker's
+HELLO carries its hostname, and the controller answers with
+(local_rank, local_size, cross_rank, cross_size) — the reference derives
+the same numbers from ``MPI_Comm_split_type(SHARED)``
+(operations.cc:1184-1196).
+
+Frame layout: ``<u32 length><u8 type><payload>`` (little-endian).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from . import wire
+from .wire import Request, Response
+
+FRAME_HELLO = 0       # worker→controller: <i rank><H len><hostname>
+FRAME_REQUEST = 1     # worker→controller: packed Request
+FRAME_RESPONSES = 2   # controller→worker: packed response list
+FRAME_TOPO = 3        # controller→worker: <iiii> local_rank local_size
+                      #                           cross_rank cross_size
+FRAME_SHUTDOWN = 4    # either direction: cooperative shutdown
+
+_HDR = struct.Struct("<IB")
+
+
+def _send_frame(sock: socket.socket, ftype: int, payload: bytes = b"") -> None:
+    sock.sendall(_HDR.pack(len(payload), ftype) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket):
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None, None
+    length, ftype = _HDR.unpack(hdr)
+    payload = _recv_exact(sock, length) if length else b""
+    if length and payload is None:
+        return None, None
+    return ftype, payload
+
+
+@dataclass(frozen=True)
+class Topology:
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+
+
+def _assign_topology(hosts: Dict[int, str]) -> Dict[int, Topology]:
+    """rank→hostname ⇒ rank→(local/cross) placement, reference semantics:
+    local = ranks sharing a host (SHARED split), cross = one rank per host
+    ordered by lowest global rank (operations.cc:1184-1196)."""
+    by_host: Dict[str, List[int]] = {}
+    for rank in sorted(hosts):
+        by_host.setdefault(hosts[rank], []).append(rank)
+    host_order = sorted(by_host, key=lambda h: by_host[h][0])
+    out: Dict[int, Topology] = {}
+    for ci, host in enumerate(host_order):
+        ranks = by_host[host]
+        for li, rank in enumerate(ranks):
+            out[rank] = Topology(local_rank=li, local_size=len(ranks),
+                                 cross_rank=ci, cross_size=len(host_order))
+    return out
+
+
+class ControllerTransport:
+    """Rank 0: accepts one connection per worker, feeds their Requests into
+    the in-process coordinator, broadcasts Response lists to everyone."""
+
+    def __init__(self, coordinator, num_processes: int, port: int,
+                 hostname: Optional[str] = None):
+        self.coordinator = coordinator
+        self.num_processes = num_processes
+        self.shutdown_requested = threading.Event()
+        self._conns: Dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", port))
+        self._srv.listen(num_processes)
+        self._threads: List[threading.Thread] = []
+
+        hosts = {0: hostname or socket.gethostname()}
+        socks: Dict[int, socket.socket] = {}
+        # Bound the wait for stragglers so a worker that died between the
+        # jax.distributed rendezvous and its HELLO produces an error naming
+        # the missing ranks instead of a silent hang.
+        accept_timeout = float(
+            os.environ.get("HVD_TPU_CONNECT_TIMEOUT", "120"))
+        self._srv.settimeout(accept_timeout)
+        for _ in range(num_processes - 1):
+            try:
+                conn, _addr = self._srv.accept()
+            except socket.timeout:
+                missing = sorted(set(range(num_processes)) - set(hosts))
+                raise TimeoutError(
+                    f"controller: ranks {missing} did not connect within "
+                    f"{accept_timeout}s; did those processes die during "
+                    f"startup?") from None
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            ftype, payload = _recv_frame(conn)
+            if ftype != FRAME_HELLO:
+                raise RuntimeError(
+                    f"controller expected HELLO, got frame type {ftype}")
+            (rank,) = struct.unpack_from("<i", payload)
+            (hlen,) = struct.unpack_from("<H", payload, 4)
+            hosts[rank] = payload[6:6 + hlen].decode("utf-8")
+            socks[rank] = conn
+        self.topology = _assign_topology(hosts)
+        for rank, conn in socks.items():
+            t = self.topology[rank]
+            _send_frame(conn, FRAME_TOPO, struct.pack(
+                "<iiii", t.local_rank, t.local_size,
+                t.cross_rank, t.cross_size))
+        with self._lock:
+            self._conns = socks
+        for rank, conn in socks.items():
+            th = threading.Thread(target=self._serve, args=(rank, conn),
+                                  name=f"hvd-controller-rx-{rank}",
+                                  daemon=True)
+            th.start()
+            self._threads.append(th)
+
+    def _serve(self, rank: int, conn: socket.socket) -> None:
+        while True:
+            ftype, payload = _recv_frame(conn)
+            if ftype is None:
+                return  # worker disconnected
+            if ftype == FRAME_REQUEST:
+                req, _ = Request.unpack(payload)
+                try:
+                    self.coordinator.submit(req)
+                except ValueError:
+                    # Duplicate-name submissions are a caller bug on the
+                    # worker; it learns via its own synchronize timeout.
+                    pass
+            elif ftype == FRAME_SHUTDOWN:
+                self.shutdown_requested.set()
+
+    # -- controller-side API used by the drain loop ------------------------
+    def submit(self, req: Request) -> None:
+        self.coordinator.submit(req)
+
+    def broadcast_responses(self, responses: List[Response]) -> None:
+        payload = wire.pack_response_list(responses)
+        with self._lock:
+            conns = list(self._conns.values())
+        for conn in conns:
+            try:
+                _send_frame(conn, FRAME_RESPONSES, payload)
+            except OSError:
+                pass  # worker already gone; its own stall path reports
+
+    def broadcast_shutdown(self) -> None:
+        with self._lock:
+            conns = list(self._conns.values())
+        for conn in conns:
+            try:
+                _send_frame(conn, FRAME_SHUTDOWN)
+            except OSError:
+                pass
+
+    def poll_responses(self):
+        return None  # responses come from the coordinator on rank 0
+
+    def close(self) -> None:
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._srv.close()
+
+
+class WorkerTransport:
+    """Ranks 1..N-1: one connection to the controller; sends Requests,
+    receives Response lists into a queue the local drain loop empties."""
+
+    def __init__(self, host: str, port: int, rank: int,
+                 hostname: Optional[str] = None,
+                 connect_timeout: float = 60.0):
+        self.rank = rank
+        self.shutdown_received = threading.Event()
+        self._responses: "queue.Queue[List[Response]]" = queue.Queue()
+        deadline = time.monotonic() + connect_timeout
+        last_err: Optional[Exception] = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=5.0)
+                break
+            except OSError as e:
+                last_err = e
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"rank {rank} could not reach the controller at "
+                        f"{host}:{port} within {connect_timeout}s: "
+                        f"{last_err}") from last_err
+                time.sleep(0.1)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        hb = (hostname or socket.gethostname()).encode("utf-8")
+        _send_frame(self._sock, FRAME_HELLO,
+                    struct.pack("<i", rank) + struct.pack("<H", len(hb)) + hb)
+        ftype, payload = _recv_frame(self._sock)
+        if ftype != FRAME_TOPO:
+            raise RuntimeError(
+                f"rank {rank} expected TOPO from controller, got {ftype}")
+        lr, ls, cr, cs = struct.unpack("<iiii", payload)
+        self.topology = Topology(lr, ls, cr, cs)
+        self._rx = threading.Thread(target=self._recv_loop,
+                                    name=f"hvd-worker-rx-{rank}", daemon=True)
+        self._rx.start()
+
+    def _recv_loop(self) -> None:
+        while True:
+            try:
+                ftype, payload = _recv_frame(self._sock)
+            except OSError:
+                return
+            if ftype is None:
+                return  # controller gone
+            if ftype == FRAME_RESPONSES:
+                self._responses.put(wire.unpack_response_list(payload))
+            elif ftype == FRAME_SHUTDOWN:
+                self.shutdown_received.set()
+
+    def submit(self, req: Request) -> None:
+        with self._send_lock:
+            _send_frame(self._sock, FRAME_REQUEST, req.pack())
+
+    def request_shutdown(self) -> None:
+        with self._send_lock:
+            _send_frame(self._sock, FRAME_SHUTDOWN)
+
+    def poll_responses(self) -> Optional[List[Response]]:
+        """Next broadcast response list, or None if nothing arrived."""
+        try:
+            return self._responses.get_nowait()
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,94 @@
+"""Process launcher: ``python -m horovod_tpu.run -np N script.py [args...]``.
+
+TPU-native stand-in for the reference's ``mpirun -np N python train.py``
+launch recipe (reference: README.md:148-177 and the Travis CI legs,
+.travis.yml:96-123).  Spawns N local worker processes wired together via
+``jax.distributed`` (the ``HVD_TPU_*`` env contract in core/cluster.py);
+each worker's stdout/stderr is prefixed with its rank, mpirun-style.
+
+For multi-node jobs, run one ``python script.py`` per node under your
+scheduler with HVD_TPU_COORDINATOR / HVD_TPU_NUM_PROCESSES /
+HVD_TPU_PROCESS_ID exported — the same contract this launcher uses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_ports(n: int) -> list:
+    # Hold all sockets open while allocating so the kernel can't hand the
+    # same ephemeral port out twice.
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _pump(stream, rank: int, out) -> None:
+    for line in iter(stream.readline, b""):
+        out.buffer.write(f"[{rank}] ".encode() + line)
+        out.flush()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.run",
+        description="Launch N cooperating horovod_tpu processes locally.")
+    ap.add_argument("-np", "--num-proc", type=int, required=True)
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform for workers (e.g. cpu)")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="script (and args) to run in each process")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("missing script to launch")
+
+    # Reserve a distinct port for the eager-op controller up front; the
+    # rendezvous-port+1 default could land on an in-use port.
+    coord_port, controller_port = _free_ports(2)
+    procs = []
+    pumps = []
+    for rank in range(args.num_proc):
+        env = dict(os.environ)
+        env["HVD_TPU_COORDINATOR"] = f"127.0.0.1:{coord_port}"
+        env["HVD_TPU_CONTROLLER_PORT"] = str(controller_port)
+        env["HVD_TPU_NUM_PROCESSES"] = str(args.num_proc)
+        env["HVD_TPU_PROCESS_ID"] = str(rank)
+        if args.platform:
+            env["JAX_PLATFORMS"] = args.platform
+            if args.platform == "cpu":
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+        p = subprocess.Popen([sys.executable] + args.command, env=env,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        procs.append(p)
+        t = threading.Thread(target=_pump, args=(p.stdout, rank, sys.stdout),
+                             daemon=True)
+        t.start()
+        pumps.append(t)
+
+    rc = 0
+    try:
+        for p in procs:
+            rc = p.wait() or rc
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        rc = 130
+    for t in pumps:
+        t.join(timeout=2.0)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

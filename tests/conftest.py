@@ -30,6 +30,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (multi-process spawns, "
+        "interpret-mode pallas backward passes)")
+
+
 @pytest.fixture()
 def hvd():
     """Initialized horovod_tpu over all 8 virtual devices; fresh per test."""
