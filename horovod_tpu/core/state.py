@@ -66,6 +66,10 @@ class _GlobalState:
 
     initialized: bool = False
     shutdown: bool = False
+    # Set when any rank initiated shutdown (≙ the reference's shut_down
+    # flag, operations.cc:134): pending ops get SHUT_DOWN_ERROR, new eager
+    # ops are refused.
+    peer_shutdown: bool = False
     # The 1-D replica mesh over every addressable device.
     mesh: Optional[jax.sharding.Mesh] = None
     # Devices in mesh order (process-major, then local ordinal).
@@ -159,6 +163,7 @@ def init(devices=None) -> None:
             os.environ.get("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024)
         )
         _state.shutdown = False
+        _state.peer_shutdown = False
         _state.initialized = True
 
         # Timeline: rank-0-only Chrome tracing, same env contract as the
@@ -224,17 +229,32 @@ def init(devices=None) -> None:
 
 
 def shutdown() -> None:
-    """Cooperative shutdown: flush the timeline, drop the coordinator.
+    """Cooperative shutdown (≙ operations.cc:1377-1442, :1456-1474).
 
-    Mirrors the reference's shutdown broadcast + callback flush with
-    SHUT_DOWN_ERROR (operations.cc:1377-1442, :1456-1474) — under SPMD there
-    are no in-flight negotiated tensors to poison, so this reduces to
-    releasing state; pending async handles stay valid (XLA owns them).
+    Protocol: notify the peers (worker → SHUTDOWN frame to the
+    controller; controller → SHUTDOWN response broadcast), then flush
+    every still-pending async collective with the reference's
+    SHUT_DOWN_ERROR so late ``synchronize`` calls raise it, then release
+    the runtime.  Launched ops' handles stay valid — XLA owns those.
     """
+    # Stop the background drain FIRST so the protocol below can't race an
+    # in-flight poll/broadcast on the same sockets and op queue.
     if _state.bg_stop is not None:
         _state.bg_stop.set()
         if _state.bg_thread is not None:
             _state.bg_thread.join(timeout=2.0)
+    if _state.initialized:
+        from ..ops import collective as _collective
+
+        with _collective._drain_lock:
+            if (_state.multiprocess and _state.transport is not None
+                    and _state.process_index != 0):
+                try:
+                    _state.transport.request_shutdown()
+                except OSError:
+                    pass  # controller already gone
+            if not _state.peer_shutdown:
+                _collective._initiate_shutdown()
     with _state.lock:
         _state.bg_thread = None
         _state.bg_stop = None

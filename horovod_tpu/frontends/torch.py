@@ -43,7 +43,11 @@ from ..core.state import (init, is_initialized, local_rank, local_size,  # noqa:
                           mpi_threads_supported, rank, shutdown, size)
 from ..ops import collective as _C
 
-# handle -> (target tensor for in-place write-back or None, torch dtype)
+# handle -> (target tensor for in-place write-back or None, torch dtype).
+# Strong references (the target may be a temporary view object like
+# ``p.data`` whose storage we must mutate); ``poll`` releases the entry as
+# soon as it observes completion by performing the write-back eagerly, so
+# polled-and-abandoned handles do not pin tensors.
 _inplace_targets: Dict[int, Tuple[Optional[torch.Tensor], torch.dtype]] = {}
 
 
@@ -76,27 +80,45 @@ def _enqueue(op: str, tensor: torch.Tensor, *, inplace: bool,
     return handle
 
 
+def _write_back(handle: int, result: np.ndarray) -> Optional[torch.Tensor]:
+    """Copy ``result`` into the handle's in-place target (if any), release
+    the map entry, and return the target tensor."""
+    target, dtype = _inplace_targets.pop(handle, (None, None))
+    if target is None:
+        return None
+    out = _from_numpy(result, dtype)
+    if target.shape != out.shape:
+        target.resize_(out.shape)
+    target.copy_(out)
+    return target
+
+
 def poll(handle: int) -> bool:
     """Non-blocking completion check (≙ horovod_torch_poll,
-    torch/mpi_ops.py:318-325)."""
-    return _C.poll(handle)
+    torch/mpi_ops.py:318-325).  On completion the in-place write-back
+    happens immediately and the target reference is released, so a
+    polled-then-abandoned handle never pins the caller's tensor."""
+    done = _C.poll(handle)
+    if done:
+        st = _state.global_state()
+        h = st.handle_manager._get(handle)
+        if not isinstance(h.result, _C.HorovodError):
+            _write_back(handle, np.asarray(h.result))
+    return done
 
 
 def synchronize(handle: int) -> torch.Tensor:
     """Block until ``handle`` completes; returns the result tensor (and
     copies it into the original for in-place ops) —
     ≙ torch/mpi_ops.py:328-344."""
+    dtype = _inplace_targets.get(handle, (None, None))[1]
     result = np.asarray(_C.synchronize(handle))
-    target, dtype = _inplace_targets.pop(handle, (None, None))
+    target = _write_back(handle, result)
+    if target is not None:
+        return target
     if dtype is None:
         dtype = torch.from_numpy(result).dtype
-    out = _from_numpy(result, dtype)
-    if target is not None:
-        if target.shape != out.shape:
-            target.resize_(out.shape)
-        target.copy_(out)
-        return target
-    return out
+    return _from_numpy(result, dtype)
 
 
 # -- allreduce --------------------------------------------------------------

@@ -30,7 +30,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from . import wire
-from .wire import Request, Response
+from .wire import Request, Response, ResponseType
 
 FRAME_HELLO = 0       # worker→controller: <i rank><H len><hostname>
 FRAME_REQUEST = 1     # worker→controller: packed Request
@@ -103,6 +103,7 @@ class ControllerTransport:
         self.shutdown_requested = threading.Event()
         self._conns: Dict[int, socket.socket] = {}
         self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("0.0.0.0", port))
@@ -152,7 +153,10 @@ class ControllerTransport:
 
     def _serve(self, rank: int, conn: socket.socket) -> None:
         while True:
-            ftype, payload = _recv_frame(conn)
+            try:
+                ftype, payload = _recv_frame(conn)
+            except OSError:
+                return  # worker died mid-frame / reset the connection
             if ftype is None:
                 return  # worker disconnected
             if ftype == FRAME_REQUEST:
@@ -172,22 +176,17 @@ class ControllerTransport:
 
     def broadcast_responses(self, responses: List[Response]) -> None:
         payload = wire.pack_response_list(responses)
-        with self._lock:
-            conns = list(self._conns.values())
-        for conn in conns:
-            try:
-                _send_frame(conn, FRAME_RESPONSES, payload)
-            except OSError:
-                pass  # worker already gone; its own stall path reports
-
-    def broadcast_shutdown(self) -> None:
-        with self._lock:
-            conns = list(self._conns.values())
-        for conn in conns:
-            try:
-                _send_frame(conn, FRAME_SHUTDOWN)
-            except OSError:
-                pass
+        # _send_lock serializes whole frames: the drain thread and a
+        # shutdown()-calling user thread must not interleave bytes on one
+        # socket.
+        with self._send_lock:
+            with self._lock:
+                conns = list(self._conns.values())
+            for conn in conns:
+                try:
+                    _send_frame(conn, FRAME_RESPONSES, payload)
+                except OSError:
+                    pass  # worker already gone; its own stall path reports
 
     def poll_responses(self):
         return None  # responses come from the coordinator on rank 0
@@ -254,9 +253,14 @@ class WorkerTransport:
             if ftype is None:
                 return  # controller gone
             if ftype == FRAME_RESPONSES:
-                self._responses.put(wire.unpack_response_list(payload))
-            elif ftype == FRAME_SHUTDOWN:
-                self.shutdown_received.set()
+                resps = wire.unpack_response_list(payload)
+                # Controller-initiated shutdown arrives as a SHUTDOWN-type
+                # Response inside the list (the one spelling of the
+                # protocol); note it for observability.
+                if any(r.response_type == ResponseType.SHUTDOWN
+                       for r in resps):
+                    self.shutdown_received.set()
+                self._responses.put(resps)
 
     def submit(self, req: Request) -> None:
         with self._send_lock:

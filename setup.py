@@ -125,7 +125,7 @@ setup(
     install_requires=["jax", "numpy"],
     extras_require={
         "models": ["flax", "optax"],
-        "torch": ["torch"],
+        "torch": ["torch>=2.1"],
         "test": ["pytest"],
     },
     cmdclass={"build_py": build_py_with_native,

@@ -88,6 +88,30 @@ def scenario_stall(hvd):
     print(f"STALL_OK rank={rank}")
 
 
+def scenario_shutdown(hvd):
+    import jax.numpy as jnp
+
+    from horovod_tpu import HorovodError
+
+    rank = hvd.rank()
+    if rank == 0:
+        # This op can never complete: rank 1 shuts down instead of
+        # submitting.  The SHUTDOWN it triggers must poison the handle.
+        h = hvd.allreduce_async(jnp.ones((2,)), name="doomed.op",
+                                average=False)
+        try:
+            hvd.synchronize(h)
+        except HorovodError as e:
+            assert "shut down" in str(e), str(e)
+            print(f"SHUTDOWN_OK rank={rank}")
+            return
+        raise AssertionError("shutdown did not poison the pending op")
+    else:
+        time.sleep(1.0)
+        hvd.shutdown()
+        print(f"SHUTDOWN_OK rank={rank}")
+
+
 def main():
     scenario = sys.argv[1]
     import horovod_tpu as hvd

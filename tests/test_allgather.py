@@ -54,15 +54,18 @@ def test_allgather_variable_sizes(hvd):
 def test_allgather_ndim_mismatch_raises(hvd):
     if hvd.size() < 2:
         pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.coordinator import PyCoordinator
     from horovod_tpu.ops.wire import Request, RequestType, DataType
 
-    st = __import__("horovod_tpu").core.state.global_state()
+    # Private coordinator: the shared one is drained by the background
+    # tick thread, which would race these direct injections.
+    coord = PyCoordinator(hvd.size(), 64 << 20)
     name = "gather.mismatch.ndim"
     for r in range(hvd.size()):
         shape = (2, 3) if r % 2 == 0 else (2, 3, 4)
-        st.coordinator.submit(Request(r, RequestType.ALLGATHER,
-                                      DataType.FLOAT32, name, -1, -1, shape))
-    resps = st.coordinator.poll_responses({name: 24})
+        coord.submit(Request(r, RequestType.ALLGATHER,
+                             DataType.FLOAT32, name, -1, -1, shape))
+    resps = coord.poll_responses({name: 24})
     assert resps[0].response_type.name == "ERROR"
     assert "sent a tensor of rank" in resps[0].error_message
 
@@ -72,15 +75,16 @@ def test_allgather_dim_mismatch_raises(hvd):
     (≙ test_tensorflow.py:393-427)."""
     if hvd.size() < 2:
         pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.coordinator import PyCoordinator
     from horovod_tpu.ops.wire import Request, RequestType, DataType
 
-    st = __import__("horovod_tpu").core.state.global_state()
+    coord = PyCoordinator(hvd.size(), 64 << 20)
     name = "gather.mismatch.dim"
     for r in range(hvd.size()):
         shape = (2, 3) if r % 2 == 0 else (5, 4)
-        st.coordinator.submit(Request(r, RequestType.ALLGATHER,
-                                      DataType.FLOAT32, name, -1, -1, shape))
-    resps = st.coordinator.poll_responses({name: 24})
+        coord.submit(Request(r, RequestType.ALLGATHER,
+                             DataType.FLOAT32, name, -1, -1, shape))
+    resps = coord.poll_responses({name: 24})
     assert resps[0].response_type.name == "ERROR"
     assert "dimension 1" in resps[0].error_message
 

@@ -90,16 +90,18 @@ def test_allreduce_shape_mismatch_raises(hvd):
         pytest.skip("needs >1 replica")
     # Build two half-sized per-replica groups with conflicting shapes under
     # the same tensor name by submitting raw requests through the queue.
-    from horovod_tpu.ops import collective as C
+    from horovod_tpu.ops.coordinator import PyCoordinator
     from horovod_tpu.ops.wire import Request, RequestType, DataType
 
-    st = C._state.global_state()
+    # Private coordinator: the shared one is drained by the background
+    # tick thread, which would race these direct injections.
+    coord = PyCoordinator(hvd.size(), 64 << 20)
     name = "mismatch.shape"
     for r in range(hvd.size()):
         shape = (2, 3) if r % 2 == 0 else (3, 2)
-        st.coordinator.submit(Request(r, RequestType.ALLREDUCE,
-                                      DataType.FLOAT32, name, -1, -1, shape))
-    resps = st.coordinator.poll_responses({name: 24})
+        coord.submit(Request(r, RequestType.ALLREDUCE,
+                             DataType.FLOAT32, name, -1, -1, shape))
+    resps = coord.poll_responses({name: 24})
     assert len(resps) == 1
     assert resps[0].response_type.name == "ERROR"
     assert "Mismatched allreduce tensor shapes" in resps[0].error_message
@@ -108,15 +110,16 @@ def test_allreduce_shape_mismatch_raises(hvd):
 def test_allreduce_dtype_mismatch_raises(hvd):
     if hvd.size() < 2:
         pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.coordinator import PyCoordinator
     from horovod_tpu.ops.wire import Request, RequestType, DataType
 
-    st = __import__("horovod_tpu").core.state.global_state()
+    coord = PyCoordinator(hvd.size(), 64 << 20)
     name = "mismatch.dtype"
     for r in range(hvd.size()):
         dt = DataType.FLOAT32 if r % 2 == 0 else DataType.INT32
-        st.coordinator.submit(Request(r, RequestType.ALLREDUCE, dt, name,
-                                      -1, -1, (3,)))
-    resps = st.coordinator.poll_responses({name: 12})
+        coord.submit(Request(r, RequestType.ALLREDUCE, dt, name,
+                             -1, -1, (3,)))
+    resps = coord.poll_responses({name: 12})
     assert resps[0].response_type.name == "ERROR"
     assert "Mismatched data types" in resps[0].error_message
 
@@ -126,15 +129,16 @@ def test_mismatched_operations_raise(hvd):
     (≙ mpi_ops mismatch tests, test_tensorflow.py:259-305)."""
     if hvd.size() < 2:
         pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.coordinator import PyCoordinator
     from horovod_tpu.ops.wire import Request, RequestType, DataType
 
-    st = __import__("horovod_tpu").core.state.global_state()
+    coord = PyCoordinator(hvd.size(), 64 << 20)
     name = "mismatch.op"
     for r in range(hvd.size()):
         op = RequestType.ALLREDUCE if r % 2 == 0 else RequestType.ALLGATHER
-        st.coordinator.submit(Request(r, op, DataType.FLOAT32, name,
-                                      -1, -1, (3,)))
-    resps = st.coordinator.poll_responses({name: 12})
+        coord.submit(Request(r, op, DataType.FLOAT32, name,
+                             -1, -1, (3,)))
+    resps = coord.poll_responses({name: 12})
     assert resps[0].response_type.name == "ERROR"
     assert "Mismatched collective operations" in resps[0].error_message
 

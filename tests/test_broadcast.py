@@ -35,16 +35,19 @@ def test_broadcast_root_rank_mismatch_raises(hvd):
     (≙ test_horovod_broadcast_rank_error, test_tensorflow.py:459-509)."""
     if hvd.size() < 2:
         pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.coordinator import PyCoordinator
     from horovod_tpu.ops.wire import Request, RequestType, DataType
 
-    st = __import__("horovod_tpu").core.state.global_state()
+    # Private coordinator: the shared one is drained by the background
+    # tick thread, which would race these direct injections.
+    coord = PyCoordinator(hvd.size(), 64 << 20)
     name = "bcast.mismatch.root"
     for r in range(hvd.size()):
-        st.coordinator.submit(Request(r, RequestType.BROADCAST,
-                                      DataType.FLOAT32, name,
-                                      root_rank=r % 2, device=-1,
-                                      tensor_shape=(3,)))
-    resps = st.coordinator.poll_responses({name: 12})
+        coord.submit(Request(r, RequestType.BROADCAST,
+                             DataType.FLOAT32, name,
+                             root_rank=r % 2, device=-1,
+                             tensor_shape=(3,)))
+    resps = coord.poll_responses({name: 12})
     assert resps[0].response_type.name == "ERROR"
     assert "Mismatched broadcast root ranks" in resps[0].error_message
 
@@ -52,17 +55,18 @@ def test_broadcast_root_rank_mismatch_raises(hvd):
 def test_broadcast_shape_mismatch_raises(hvd):
     if hvd.size() < 2:
         pytest.skip("needs >1 replica")
+    from horovod_tpu.ops.coordinator import PyCoordinator
     from horovod_tpu.ops.wire import Request, RequestType, DataType
 
-    st = __import__("horovod_tpu").core.state.global_state()
+    coord = PyCoordinator(hvd.size(), 64 << 20)
     name = "bcast.mismatch.shape"
     for r in range(hvd.size()):
         shape = (3,) if r % 2 == 0 else (4,)
-        st.coordinator.submit(Request(r, RequestType.BROADCAST,
-                                      DataType.FLOAT32, name,
-                                      root_rank=0, device=-1,
-                                      tensor_shape=shape))
-    resps = st.coordinator.poll_responses({name: 12})
+        coord.submit(Request(r, RequestType.BROADCAST,
+                             DataType.FLOAT32, name,
+                             root_rank=0, device=-1,
+                             tensor_shape=shape))
+    resps = coord.poll_responses({name: 12})
     assert resps[0].response_type.name == "ERROR"
     assert "Mismatched broadcast tensor shapes" in resps[0].error_message
 

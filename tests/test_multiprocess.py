@@ -48,6 +48,13 @@ def test_two_process_mismatch_raises_on_both_ranks():
 
 
 @pytest.mark.slow
+def test_two_process_shutdown_poisons_peer_pending_op():
+    out = _launch("shutdown")
+    assert "SHUTDOWN_OK rank=0" in out
+    assert "SHUTDOWN_OK rank=1" in out
+
+
+@pytest.mark.slow
 def test_two_process_stall_warning_names_missing_rank():
     out = _launch("stall",
                   extra_env={"HOROVOD_STALL_WARNING_SECONDS": "1.5"})

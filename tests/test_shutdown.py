@@ -1,0 +1,40 @@
+"""Cooperative-shutdown protocol tests (≙ reference
+operations.cc:1377-1474: pending callbacks flushed with SHUT_DOWN_ERROR,
+subsequent ops refused)."""
+
+import jax.numpy as jnp
+import pytest
+
+
+def test_shutdown_poisons_pending_async_op(hvd):
+    import horovod_tpu as H
+
+    st = H.core.state.global_state()
+    # Freeze the background tick so the op stays queued (un-launched).
+    st.bg_stop.set()
+    st.bg_thread.join(timeout=2.0)
+    handle = H.allreduce_async(jnp.ones(4), name="pending.at.shutdown")
+    H.shutdown()
+    with pytest.raises(H.HorovodError, match="shut down"):
+        H.synchronize(handle)
+
+
+def test_ops_after_peer_shutdown_raise(hvd):
+    import horovod_tpu as H
+
+    st = H.core.state.global_state()
+    st.peer_shutdown = True  # what a SHUTDOWN response sets
+    with pytest.raises(H.HorovodError, match="shut down"):
+        H.allreduce(jnp.ones(2))
+
+
+def test_completed_op_survives_shutdown(hvd):
+    """Launched collectives belong to XLA; shutdown must not invalidate
+    their handles (only *pending* ones are poisoned)."""
+    import horovod_tpu as H
+
+    n = hvd.size()
+    handle = H.allreduce_async(jnp.ones(3), average=False, name="done.op")
+    out = H.synchronize(handle)
+    H.shutdown()
+    assert float(out[0]) == float(n)
