@@ -43,10 +43,11 @@ def main():
             params.embeddings, params.nce_weights, params.nce_biases,
             jnp.asarray(centers), jnp.asarray(targets), jnp.asarray(negs))
 
-        # Embedding gradient: sparse exchange (touched rows only), exactly
-        # the reference's device_sparse path.
+        # Embedding gradient: hvd.allreduce dispatches IndexedSlices to the
+        # sparse exchange (touched rows only) transparently, exactly like
+        # the reference (tensorflow/__init__.py:67-78).
         sl = S.sparse_grad_from_dense(g_emb, jnp.asarray(centers))
-        sl = S.allreduce(sl, average=True, name=f"w2v.emb.{step}")
+        sl = hvd.allreduce(sl, average=True, name=f"w2v.emb.{step}")
         new_emb = S.apply_to(params.embeddings, sl, scale=-lr)
 
         # NCE weights/biases: dense averaged allreduce.

@@ -72,10 +72,17 @@ def allreduce_gradients(grads, average: bool = True,
     fusion buffer (operations.cc:941-1034) but letting XLA schedule and
     overlap the collectives.  A threshold of 0 disables fusion (one psum
     per tensor, reference docs/tensor-fusion.md).
+
+    :class:`~horovod_tpu.ops.sparse.IndexedSlices` leaves exchange as an
+    all_gather of (values, indices) — the reference's sparse branch
+    (tensorflow/__init__.py:67-78) — and stay sparse in the result.
     """
+    from ..ops.sparse import IndexedSlices
+
     threshold = (_fusion_threshold_bytes()
                  if fusion_threshold is None else fusion_threshold)
-    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    leaves, treedef = jax.tree_util.tree_flatten(
+        grads, is_leaf=lambda g: isinstance(g, IndexedSlices))
     if not leaves:
         return grads
     denom = None
@@ -86,14 +93,26 @@ def allreduce_gradients(grads, average: bool = True,
     def finish(x):
         return (x / denom.astype(x.dtype)) if average else x
 
+    def gather_sparse(g):
+        vals = jax.lax.all_gather(g.values, REPLICA_AXIS, axis=0,
+                                  tiled=True)
+        idxs = jax.lax.all_gather(g.indices, REPLICA_AXIS, axis=0,
+                                  tiled=True)
+        return IndexedSlices(finish(vals), idxs, g.dense_shape)
+
     if threshold <= 0:
-        red = [finish(jax.lax.psum(g, REPLICA_AXIS)) for g in leaves]
+        red = [gather_sparse(g) if isinstance(g, IndexedSlices)
+               else finish(jax.lax.psum(g, REPLICA_AXIS)) for g in leaves]
         return jax.tree_util.tree_unflatten(treedef, red)
 
-    # Bucket by dtype, preserving leaf order for unflatten.
+    # Bucket by dtype, preserving leaf order for unflatten.  Sparse leaves
+    # bypass bucketing (their payload is already minimal).
     out: list = [None] * len(leaves)
     by_dtype: dict = {}
     for i, g in enumerate(leaves):
+        if isinstance(g, IndexedSlices):
+            out[i] = gather_sparse(g)
+            continue
         by_dtype.setdefault(jnp.asarray(g).dtype, []).append(i)
     for dtype, idxs in by_dtype.items():
         bucket: list = []
@@ -130,22 +149,50 @@ def allreduce_gradients(grads, average: bool = True,
 def _eager_allreduce_grads(grads, average: bool = True):
     """Dynamic-path gradient reduction: fire all allreduces async, then
     synchronize — the Torch hook + step() pattern (torch/__init__.py:62-87),
-    with coordinator-level fusion batching the small tensors."""
+    with coordinator-level fusion batching the small tensors.  Sparse
+    (IndexedSlices) leaves take the allgather exchange transparently."""
     from ..ops import collective as C
+    from ..ops import sparse as S
 
-    leaves, treedef = jax.tree_util.tree_flatten(grads)
-    if any(isinstance(g, jax.core.Tracer) for g in leaves):
+    leaves, treedef = jax.tree_util.tree_flatten(
+        grads, is_leaf=lambda g: isinstance(g, S.IndexedSlices))
+
+    def _is_traced(g):
+        if isinstance(g, S.IndexedSlices):
+            return any(isinstance(f, jax.core.Tracer)
+                       for f in (g.values, g.indices))
+        return isinstance(g, jax.core.Tracer)
+
+    if any(_is_traced(g) for g in leaves):
         raise RuntimeError(
             "DistributedOptimizer.update was traced (jit) outside a replica "
             "context. Either call it inside shard_map/pmap over the "
             f"'{REPLICA_AXIS}' axis, or build the step with "
             "horovod_tpu.parallel.training.make_train_step, which wires the "
             "reduction into the SPMD program.")
-    handles = [
-        C.allreduce_async(g, average=average, name=f"grad.{i}")
-        for i, g in enumerate(leaves)
-    ]
-    red = [C.synchronize(h) for h in handles]
+    # Fire EVERYTHING async first (sparse = one allgather pair per leaf),
+    # then synchronize — so sparse and dense exchanges all overlap.
+    handles = []
+    for i, g in enumerate(leaves):
+        if isinstance(g, S.IndexedSlices):
+            handles.append((g, C.allgather_async(g.values,
+                                                 name=f"grad.{i}.values"),
+                            C.allgather_async(g.indices,
+                                              name=f"grad.{i}.indices")))
+        else:
+            handles.append(C.allreduce_async(g, average=average,
+                                             name=f"grad.{i}"))
+    denom = _state.contributor_count()
+    red = []
+    for h in handles:
+        if isinstance(h, tuple):
+            g, hv, hi = h
+            values = C.synchronize(hv)
+            red.append(S.IndexedSlices(
+                values / denom if average else values,
+                C.synchronize(hi), g.dense_shape))
+        else:
+            red.append(C.synchronize(h))
     return jax.tree_util.tree_unflatten(treedef, red)
 
 
@@ -165,16 +212,31 @@ class DistributedOptimizer:
 
     def __init__(self, optimizer, average: bool = True,
                  fusion_threshold: Optional[int] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None, sparse_as_dense: bool = False):
         self._inner = optimizer
         self._average = average
         self._fusion_threshold = fusion_threshold
         self._name = name or "DistributedOptimizer"
+        # ≙ the reference's device_dense/device_sparse per-op routing
+        # choice (tensorflow/__init__.py:49-60): True forces sparse grads
+        # through the dense psum path (cheaper when most rows are touched).
+        self._sparse_as_dense = sparse_as_dense
 
     def init(self, params):
         return self._inner.init(params)
 
+    def _map_sparse(self, grads, fn):
+        from ..ops.sparse import IndexedSlices
+
+        return jax.tree_util.tree_map(
+            lambda g: fn(g) if isinstance(g, IndexedSlices) else g, grads,
+            is_leaf=lambda g: isinstance(g, IndexedSlices))
+
     def update(self, grads, opt_state, params=None, **kw):
+        from ..ops import sparse as S
+
+        if self._sparse_as_dense:
+            grads = self._map_sparse(grads, S.as_dense)
         if _in_replica_context():
             grads = allreduce_gradients(
                 grads, average=self._average,
@@ -186,6 +248,11 @@ class DistributedOptimizer:
             #       same — collectives still run but are trivial).
         else:
             raise _state.NotInitializedError()
+        # The exchange is sparse (the wire win); optax transformations are
+        # dense, so scatter-sum the gathered slices before the update.
+        # (The reference hands IndexedSlices to TF's sparse apply instead —
+        # tensorflow/__init__.py:178-192 — optax has no sparse apply.)
+        grads = self._map_sparse(grads, S.as_dense)
         return self._inner.update(grads, opt_state, params, **kw)
 
     # optax GradientTransformation duck-typing.

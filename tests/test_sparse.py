@@ -103,3 +103,86 @@ def test_sparse_grad_from_dense_no_padding_duplication(hvd):
     out = np.asarray(S.as_dense(sl))
     np.testing.assert_allclose(out[9], [1.0, 1.0])  # not 2x
     np.testing.assert_allclose(out[5], [2.0, 2.0])
+
+
+def test_allreduce_dispatches_indexed_slices(hvd):
+    """hvd.allreduce on IndexedSlices takes the sparse path transparently
+    (≙ tensorflow/__init__.py:67-78) and matches the dense result."""
+    import horovod_tpu as H
+
+    dense_shape = (20, 3)
+    sl = S.IndexedSlices(jnp.full((2, 3), 4.0), jnp.asarray([3, 7]),
+                         dense_shape)
+    out = H.allreduce(sl, average=True, name="dispatch.sparse")
+    assert isinstance(out, S.IndexedSlices)
+    got = np.asarray(S.as_dense(out))
+    want = np.asarray(S.as_dense(sl))  # every replica contributed the same
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_distributed_optimizer_sparse_matches_dense(hvd):
+    """DistributedOptimizer.update with an IndexedSlices leaf produces the
+    same update as the equivalent dense gradient (eager path)."""
+    import horovod_tpu as H
+
+    dense_shape = (12, 4)
+    dense_grad = jnp.zeros(dense_shape).at[2].set(1.5).at[9].set(-0.5)
+    sparse_grad = S.sparse_grad_from_dense(dense_grad,
+                                           jnp.asarray([2, 9], jnp.int32))
+    params = {"emb": jnp.ones(dense_shape), "w": jnp.ones((4,))}
+    opt = H.DistributedOptimizer(optax.sgd(0.1))
+    state0 = opt.init(params)
+
+    g_dense = {"emb": dense_grad, "w": jnp.full((4,), 2.0)}
+    g_sparse = {"emb": sparse_grad, "w": jnp.full((4,), 2.0)}
+    upd_dense, _ = opt.update(g_dense, state0, params)
+    upd_sparse, _ = opt.update(g_sparse, state0, params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b), rtol=1e-6),
+        upd_dense, upd_sparse)
+
+
+def test_distributed_optimizer_sparse_as_dense_override(hvd):
+    """sparse_as_dense=True densifies before the exchange (the reference's
+    device_dense routing choice) with identical results."""
+    import horovod_tpu as H
+
+    dense_shape = (8, 2)
+    sparse_grad = S.IndexedSlices(jnp.full((1, 2), 3.0),
+                                  jnp.asarray([5], jnp.int32), dense_shape)
+    params = {"emb": jnp.zeros(dense_shape)}
+    for flag in (False, True):
+        opt = H.DistributedOptimizer(optax.sgd(1.0), sparse_as_dense=flag)
+        upd, _ = opt.update({"emb": sparse_grad}, opt.init(params), params)
+        out = np.asarray(upd["emb"])
+        np.testing.assert_allclose(out[5], [-3.0, -3.0], rtol=1e-6)
+        assert np.all(out[:5] == 0) and np.all(out[6:] == 0)
+
+
+def test_static_path_sparse_gradients(hvd):
+    """IndexedSlices leaves reduce inside a shard_map trace via all_gather
+    (the SPMD spelling of the sparse exchange)."""
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel.data import allreduce_gradients
+
+    size = hvd.size()
+    dense_shape = (size * 2, 3)
+
+    def step(vals, idxs):
+        vals = jnp.squeeze(vals, 0)
+        idxs = jnp.squeeze(idxs, 0)
+        sl = S.IndexedSlices(vals, idxs, dense_shape)
+        red = allreduce_gradients({"e": sl}, average=False)["e"]
+        return S.as_dense(red)[None]
+
+    mesh = hvd.mesh()
+    vals = jnp.stack([jnp.full((1, 3), float(r + 1)) for r in range(size)])
+    idxs = jnp.stack([jnp.asarray([2 * r], jnp.int32) for r in range(size)])
+    fn = jax.jit(jax.shard_map(step, mesh=mesh,
+                               in_specs=(P("hvd"), P("hvd")),
+                               out_specs=P("hvd"), check_vma=False))
+    out = np.asarray(fn(hvd.shard(vals), hvd.shard(idxs)))
+    for r in range(size):
+        np.testing.assert_allclose(out[r, 2 * r], float(r + 1))
