@@ -14,8 +14,11 @@ on.  It is written TPU-first:
   log-sum-exp rows plus the standard ``delta = rowsum(dO * O)`` trick, so
   nothing quadratic is ever materialized.
 
-On non-TPU backends (the CPU test mesh) the kernels run in Pallas
-interpreter mode; `flash_attention` is the single entry point either way.
+On non-TPU backends (the CPU test mesh) the default is a dense-jnp exact
+attention with the same (o, lse) contract — the Pallas interpreter is
+~1000x slower and only exercises the kernels, which the kernel tests do
+explicitly via ``interpret=True`` / ``HVD_TPU_FLASH_INTERPRET=1``.
+`flash_attention` is the single entry point either way.
 
 Layout: ``q, k, v : [batch, heads, seq, head_dim]``.
 """
@@ -23,6 +26,7 @@ Layout: ``q, k, v : [batch, heads, seq, head_dim]``.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -35,6 +39,60 @@ DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _dense_default() -> bool:
+    """On non-TPU backends, ``interpret=None`` resolves to a dense-jnp
+    path (mathematically identical exact attention) instead of the Pallas
+    interpreter, which executes ~1000x slower and exists only to test the
+    kernels themselves.  Kernel tests opt back in with ``interpret=True``
+    or ``HVD_TPU_FLASH_INTERPRET=1``."""
+    force_interpret = os.environ.get(
+        "HVD_TPU_FLASH_INTERPRET", "").lower() in ("1", "true", "yes")
+    return jax.default_backend() != "tpu" and not force_interpret
+
+
+def _dense_mask(s, *, causal, q_block_offset, q_len, k_len):
+    if not causal:
+        return s
+    q_pos = q_block_offset + jnp.arange(q_len)[:, None]
+    k_pos = jnp.arange(k_len)[None, :]
+    return jnp.where(q_pos >= k_pos, s, -jnp.inf)
+
+
+def _dense_forward(q, k, v, sm_scale, causal, q_block_offset):
+    """(o, lse) via exact dense attention — same contract as the kernel."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    s = _dense_mask(s, causal=causal, q_block_offset=q_block_offset,
+                    q_len=q.shape[2], k_len=k.shape[2])
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # -inf for masked rows
+    p = jnp.where(jnp.isneginf(lse)[..., None], 0.0,
+                  jnp.exp(s - lse[..., None]))
+    o = jnp.einsum("bhqk,bhkd->bhqd", p,
+                   v.astype(jnp.float32)).astype(q.dtype)
+    return o, lse
+
+
+def _dense_backward(res, g, *, sm_scale, causal, q_block_offset):
+    """Flash-backward math, densely: uses the caller's (possibly globally
+    accumulated) ``o``/``lse`` so ring attention's per-chunk gradients
+    stay normalized across the whole sequence."""
+    q, k, v, o, lse = res
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    gf, of = g.astype(jnp.float32), o.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * sm_scale
+    s = _dense_mask(s, causal=causal, q_block_offset=q_block_offset,
+                    q_len=q.shape[2], k_len=k.shape[2])
+    p = jnp.where(jnp.isneginf(lse)[..., None], 0.0,
+                  jnp.exp(s - lse[..., None]))
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    delta = jnp.sum(gf * of, axis=-1)                 # [b, h, q]
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+    ds = p * (dp - delta[..., None]) * sm_scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf).astype(q.dtype)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf).astype(k.dtype)
+    return dq, dk, dv.astype(v.dtype)
 
 
 def _apply_mask(s, *, q_start, k_start, kv_actual, kv_padded, causal,
@@ -130,6 +188,9 @@ def _pad_seq(x, multiple):
 def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
                    q_block_offset, interpret):
     if interpret is None:
+        if _dense_default():
+            return _dense_forward(q, k, v, sm_scale, causal,
+                                  q_block_offset)
         interpret = _interpret_default()
     batch, heads, q_len, head_dim = q.shape
     kv_len = k.shape[2]
@@ -268,6 +329,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
                     q_block_offset, interpret):
     if interpret is None:
+        if _dense_default():
+            return _dense_backward(res, g, sm_scale=sm_scale,
+                                   causal=causal,
+                                   q_block_offset=q_block_offset)
         interpret = _interpret_default()
     q, k, v, o, lse = res
     batch, heads, q_len, head_dim = q.shape
@@ -388,15 +453,17 @@ def flash_attention(q, k, v, *, causal: bool = False,
       sm_scale: softmax temperature; default ``1/sqrt(head_dim)``.
       q_block_offset: global position of q's first row relative to k's
         first row, for sequence-sharded callers (ring attention).
-      interpret: force Pallas interpreter mode (defaults to on for
-        non-TPU backends, e.g. the CPU test mesh).
+      interpret: True forces Pallas interpreter mode; None (default)
+        compiles the kernel on TPU and uses the dense-jnp fallback on
+        other backends (e.g. the CPU test mesh).
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        interpret = _interpret_default()
+    # interpret stays None here so _flash_forward/_flash_backward can pick
+    # the dense fallback on non-TPU backends.
     return _flash(q, k, v, float(sm_scale), bool(causal), int(block_q),
-                  int(block_k), int(q_block_offset), bool(interpret))
+                  int(block_k), int(q_block_offset),
+                  None if interpret is None else bool(interpret))
 
 
 def flash_attention_with_lse(q, k, v, *, causal: bool = False,
@@ -409,27 +476,17 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
     softmax across devices)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        interpret = _interpret_default()
     return _flash_forward(q, k, v, float(sm_scale), bool(causal),
                           int(block_q), int(block_k), int(q_block_offset),
-                          bool(interpret))
+                          None if interpret is None else bool(interpret))
 
 
 def mha_reference(q, k, v, *, causal: bool = False,
                   sm_scale: Optional[float] = None,
                   q_block_offset: int = 0):
-    """O(seq²) reference attention (tests compare the kernel against it)."""
+    """O(seq²) reference attention (tests compare the kernel against it).
+    One implementation with :func:`_dense_forward` so the production
+    fallback and the test reference cannot diverge."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * sm_scale
-    if causal:
-        q_len, k_len = q.shape[2], k.shape[2]
-        q_pos = q_block_offset + jnp.arange(q_len)[:, None]
-        k_pos = jnp.arange(k_len)[None, :]
-        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    p = jnp.where(jnp.isnan(p), 0.0, p)  # fully-masked rows
-    return jnp.einsum("bhqk,bhkd->bhqd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    return _dense_forward(q, k, v, sm_scale, causal, q_block_offset)[0]

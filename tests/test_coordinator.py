@@ -2,13 +2,29 @@
 detection, wire round-trip (≙ the machinery of reference
 operations.cc:222-461, :1072-1115, :1328-1374 and mpi_message.cc)."""
 
+import time
+
 import numpy as np
 import pytest
 
-from horovod_tpu.ops.coordinator import PyCoordinator, STALL_WARNING_SECONDS
+from horovod_tpu.native import lib as _native_lib
+from horovod_tpu.ops.coordinator import (NativeCoordinator, PyCoordinator,
+                                         STALL_WARNING_SECONDS)
 from horovod_tpu.ops.wire import (DataType, Request, RequestType, Response,
                                   ResponseType, pack_response_list,
                                   unpack_response_list)
+
+
+@pytest.fixture(params=["py", "native"])
+def make_coord(request):
+    """Both coordinator implementations must pass the identical matrix —
+    the Python one is the executable spec for native/coordinator.cc."""
+    if request.param == "native":
+        if not (_native_lib.NATIVE
+                and hasattr(_native_lib.raw(), "hvd_coord_fetch_responses")):
+            pytest.skip("native library not built")
+        return NativeCoordinator
+    return PyCoordinator
 
 
 def _req(rank, name, shape=(4,), op=RequestType.ALLREDUCE,
@@ -16,10 +32,10 @@ def _req(rank, name, shape=(4,), op=RequestType.ALLREDUCE,
     return Request(rank, op, dtype, name, root, device, shape)
 
 
-def test_readiness_counting():
+def test_readiness_counting(make_coord):
     """A tensor becomes ready only when all replicas submitted
     (≙ IncrementTensorCount, operations.cc:222-247)."""
-    c = PyCoordinator(size=4, fusion_threshold=1 << 20)
+    c = make_coord(4, 1 << 20)
     for r in range(3):
         assert c.submit(_req(r, "t")) is False
     assert c.submit(_req(3, "t")) is True
@@ -29,18 +45,18 @@ def test_readiness_counting():
     assert resps[0].tensor_names == ["t"]
 
 
-def test_duplicate_rank_rejected():
-    c = PyCoordinator(size=2, fusion_threshold=0)
+def test_duplicate_rank_rejected(make_coord):
+    c = make_coord(2, 0)
     c.submit(_req(0, "t"))
     with pytest.raises(ValueError):
         c.submit(_req(0, "t"))
 
 
-def test_fusion_same_dtype_under_threshold():
+def test_fusion_same_dtype_under_threshold(make_coord):
     """Two small float32 allreduces fuse into one response; an int32 one
     does not join them (fusion requires matching dtype, as the reference's
     fusion-buffer requires one dtype per buffer)."""
-    c = PyCoordinator(size=2, fusion_threshold=1024)
+    c = make_coord(2, 1024)
     for name in ("a", "b"):
         for r in range(2):
             c.submit(_req(r, name))
@@ -52,10 +68,10 @@ def test_fusion_same_dtype_under_threshold():
     assert sorted(fused[0].tensor_names) == ["a", "b"]
 
 
-def test_fusion_threshold_respected():
+def test_fusion_threshold_respected(make_coord):
     """Tensors stop fusing once the byte budget is exhausted
     (≙ operations.cc:1328-1360; HOROVOD_FUSION_THRESHOLD semantics)."""
-    c = PyCoordinator(size=1, fusion_threshold=100)
+    c = make_coord(1, 100)
     for name in ("a", "b", "c"):
         c.submit(_req(0, name))
     # a=60B, b=60B (won't fit with a), c=30B (fits with a: 90 <= 100).
@@ -66,29 +82,32 @@ def test_fusion_threshold_respected():
     assert ("b",) in names
 
 
-def test_fusion_disabled_with_zero_threshold():
-    c = PyCoordinator(size=1, fusion_threshold=0)
+def test_fusion_disabled_with_zero_threshold(make_coord):
+    c = make_coord(1, 0)
     for name in ("a", "b"):
         c.submit(_req(0, name))
     resps = c.poll_responses({"a": 8, "b": 8})
     assert all(len(r.tensor_names) == 1 for r in resps)
 
 
-def test_stall_detection():
+def test_stall_detection(make_coord):
     """Tensors pending longer than the threshold are reported with ready
     and missing replica lists (≙ CheckForStalledTensors,
-    operations.cc:1072-1115)."""
-    c = PyCoordinator(size=4, fusion_threshold=0)
-    c.submit(_req(0, "stuck"), now=0.0)
-    c.submit(_req(2, "stuck"), now=1.0)
-    warnings = c.check_stalled(now=STALL_WARNING_SECONDS + 2.0)
+    operations.cc:1072-1115).  Real timestamps + a tiny threshold so the
+    same test drives both implementations (the native one keeps its own
+    clock)."""
+    c = make_coord(4, 0)
+    c.submit(_req(0, "stuck"))
+    c.submit(_req(2, "stuck"))
+    time.sleep(0.05)
+    warnings = c.check_stalled(threshold=0.01)
     assert len(warnings) == 1
     w = warnings[0]
     assert "stuck" in w
     assert "[0, 2]" in w       # ready replicas
     assert "[1, 3]" in w       # missing replicas
     # Under the threshold: no warning.
-    assert c.check_stalled(now=30.0) == []
+    assert c.check_stalled(threshold=30.0) == []
 
 
 def test_wire_roundtrip():
@@ -112,12 +131,57 @@ def test_wire_roundtrip():
     assert out[1].response_type == ResponseType.ERROR
 
 
-def test_device_mismatch_detected():
+def test_device_mismatch_detected(make_coord):
     """Host tensor on one replica, device tensor on another → error
     (≙ the CPU-vs-GPU placement mismatch test, test_tensorflow.py:459+)."""
-    c = PyCoordinator(size=2, fusion_threshold=0)
+    c = make_coord(2, 0)
     c.submit(_req(0, "t", device=-1))
     c.submit(_req(1, "t", device=0))
     resps = c.poll_responses({"t": 16})
     assert resps[0].response_type == ResponseType.ERROR
     assert "device" in resps[0].error_message
+
+
+def test_py_native_response_parity_fuzz():
+    """Randomized request batches produce byte-identical (packed) response
+    lists from both coordinators — the 'executable spec' claim, verified
+    in both directions."""
+    if not (_native_lib.NATIVE
+            and hasattr(_native_lib.raw(), "hvd_coord_fetch_responses")):
+        pytest.skip("native library not built")
+    rng = np.random.RandomState(0)
+    dtypes = [DataType.FLOAT32, DataType.INT32, DataType.BFLOAT16]
+    ops = [RequestType.ALLREDUCE, RequestType.ALLGATHER,
+           RequestType.BROADCAST]
+    for trial in range(30):
+        size = int(rng.randint(1, 5))
+        py = PyCoordinator(size, int(rng.choice([0, 64, 1024, 1 << 20])))
+        nat = NativeCoordinator(py.size, py.fusion_threshold)
+        sizes_bytes = {}
+        for t in range(int(rng.randint(1, 6))):
+            name = f"t{trial}.{t}"
+            op = ops[rng.randint(len(ops))]
+            sizes_bytes[name] = int(rng.randint(1, 200))
+            # One shape/dtype/root per tensor so agreement (and therefore
+            # successful fused responses) is the common case; disagreement
+            # is injected explicitly to exercise the ERROR paths.
+            base_shape = (int(rng.randint(1, 4)), 3)
+            base_dtype = dtypes[rng.randint(len(dtypes))]
+            root = int(rng.randint(0, size))
+            for r in range(size):
+                shape, dt = base_shape, base_dtype
+                if op == RequestType.ALLGATHER and rng.rand() < 0.5:
+                    # Ragged dim 0 is legal for allgather (Allgatherv).
+                    shape = (int(rng.randint(1, 6)), shape[1])
+                if rng.rand() < 0.1:
+                    shape = (shape[0], 4)
+                if rng.rand() < 0.1:
+                    dt = dtypes[(dtypes.index(dt) + 1) % len(dtypes)]
+                py_req = _req(r, name, shape=shape, op=op, dtype=dt,
+                              root=root)
+                py.submit(py_req)
+                nat.submit(py_req)
+        py_resps = py.poll_responses(sizes_bytes)
+        nat_resps = nat.poll_responses(sizes_bytes)
+        assert pack_response_list(py_resps) == pack_response_list(
+            nat_resps), (trial, py_resps, nat_resps)

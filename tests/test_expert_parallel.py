@@ -33,10 +33,10 @@ def _run(n_devices, x, params, **kw):
         return moe_layer(x, mine, axis_name=EXPERT_AXIS, num_experts=E,
                          **kw)
 
-    return jax.shard_map(
+    return jax.jit(jax.shard_map(
         f, mesh=mesh, in_specs=(P(EXPERT_AXIS), P()),
         out_specs=MoEOutput(P(EXPERT_AXIS), P(), P()),
-        check_vma=False)(x, params)
+        check_vma=False))(x, params)
 
 
 @pytest.mark.parametrize("top_k", [1, 2])
@@ -83,20 +83,20 @@ def test_moe_gradients_flow_to_all_param_groups():
     x, params = _inputs(tokens=32)
     mesh = make_mesh(expert=4, devices=jax.devices()[:4])
 
-    sm = jax.shard_map(
+    sm = jax.jit(jax.shard_map(
         lambda x, params: moe_layer(
             x, local_experts(params, axis_name=EXPERT_AXIS),
             axis_name=EXPERT_AXIS, num_experts=E, top_k=2,
             capacity_factor=4.0),
         mesh=mesh, in_specs=(P(EXPERT_AXIS), P()),
         out_specs=MoEOutput(P(EXPERT_AXIS), P(), P()),
-        check_vma=False)
+        check_vma=False))
 
     def loss(params):
         out, aux, _ = sm(x, params)
         return jnp.sum(out ** 2) + aux
 
-    grads = jax.grad(loss)(params)
+    grads = jax.jit(jax.grad(loss))(params)
     for name, g in grads.items():
         assert bool(jnp.any(g != 0)), f"no gradient reached {name}"
         assert bool(jnp.all(jnp.isfinite(g)))

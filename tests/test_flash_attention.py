@@ -16,6 +16,13 @@ from horovod_tpu.ops.flash_attention import (flash_attention,
 TOL = 5e-5
 
 
+@pytest.fixture(autouse=True)
+def _force_pallas_interpreter(monkeypatch):
+    """These tests verify the Pallas kernels themselves: disable the
+    dense-jnp CPU fallback that the rest of the suite rides."""
+    monkeypatch.setenv("HVD_TPU_FLASH_INTERPRET", "1")
+
+
 def _qkv(b=2, h=3, s=128, d=32, dtype=jnp.float32, seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
     return tuple(jax.random.normal(k, (b, h, s, d), dtype) for k in ks)

@@ -44,8 +44,8 @@ def test_gpipe_matches_sequential(n_stages, n_micro):
         mine = select_stage_params(params)
         return gpipe(_stage_fn, mine, x, num_microbatches=n_micro)
 
-    got = jax.shard_map(run, mesh=mesh, in_specs=(P(), P()),
-                        out_specs=P(), check_vma=False)(params, x)
+    got = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(P(), P()),
+                                out_specs=P(), check_vma=False))(params, x)
     want = _sequential(params, x)
     assert jnp.max(jnp.abs(got - want)) < TOL
 
@@ -61,7 +61,7 @@ def test_gpipe_gradients_match_sequential():
         lambda params, x: gpipe(_stage_fn, select_stage_params(params), x,
                                 num_microbatches=n_micro),
         mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False)
-    got = jax.grad(lambda p: jnp.sum(sm(p, x) ** 2))(params)
+    got = jax.jit(jax.grad(lambda p: jnp.sum(sm(p, x) ** 2)))(params)
     want = jax.grad(lambda p: jnp.sum(_sequential(p, x) ** 2))(params)
     for a, b in zip(got, want):
         assert jnp.max(jnp.abs(a - b)) < 1e-4
@@ -81,9 +81,9 @@ def test_gpipe_rejects_indivisible_microbatches():
 
 def test_stage_index():
     mesh = make_mesh(pipe=4, devices=jax.devices()[:4])
-    out = jax.shard_map(lambda: stage_index()[None], mesh=mesh,
-                        in_specs=(), out_specs=P(PIPE_AXIS),
-                        check_vma=False)()
+    out = jax.jit(jax.shard_map(lambda: stage_index()[None], mesh=mesh,
+                                in_specs=(), out_specs=P(PIPE_AXIS),
+                                check_vma=False))()
     assert list(out) == [0, 1, 2, 3]
 
 
@@ -97,7 +97,8 @@ def test_gpipe_composes_with_data_parallel():
         mine = select_stage_params(params)
         return gpipe(_stage_fn, mine, x, num_microbatches=2)
 
-    got = jax.shard_map(run, mesh=mesh, in_specs=(P(), P("data")),
-                        out_specs=P("data"), check_vma=False)(params, x)
+    got = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(P(), P("data")),
+                                out_specs=P("data"),
+                                check_vma=False))(params, x)
     want = _sequential(params, x)
     assert jnp.max(jnp.abs(got - want)) < TOL

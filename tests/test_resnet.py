@@ -17,8 +17,8 @@ def test_resnet50_forward_shape(hvd):
     model = ResNet50(num_classes=1000)
     params, stats = init_resnet(model, image_size=64, batch_size=8)
     x = jnp.zeros((8, 64, 64, 3))
-    logits = model.apply({"params": params, "batch_stats": stats}, x,
-                         train=False)
+    logits = jax.jit(lambda p, s, x: model.apply(
+        {"params": p, "batch_stats": s}, x, train=False))(params, stats, x)
     assert logits.shape == (8, 1000)
     assert logits.dtype == jnp.float32
 

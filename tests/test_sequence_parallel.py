@@ -24,8 +24,8 @@ def _qkv(b=2, h=4, s=256, d=32, seed=1):
 
 
 def _sharded(fn, mesh):
-    return jax.shard_map(fn, mesh=mesh, in_specs=SPEC, out_specs=SPEC,
-                         check_vma=False)
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=SPEC,
+                                 out_specs=SPEC, check_vma=False))
 
 
 @pytest.mark.parametrize("ring_size", [2, 4, 8])
@@ -104,10 +104,10 @@ def test_ring_attention_composes_with_data_parallel():
     q, k, v = _qkv(b=4, s=128)
 
     spec = P("data", None, SEQ_AXIS)
-    sm = jax.shard_map(
+    sm = jax.jit(jax.shard_map(
         lambda q, k, v: ring_attention(q, k, v, causal=True, block_q=32,
                                        block_k=32),
-        mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)
+        mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False))
     o = sm(q, k, v)
     ref = mha_reference(q, k, v, causal=True)
     assert jnp.max(jnp.abs(o - ref)) < TOL

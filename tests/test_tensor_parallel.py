@@ -33,9 +33,9 @@ def test_column_then_row_matches_dense():
         h = jax.nn.gelu(h)
         return row_parallel(h, local_shard(w2, 0), b2)
 
-    got = jax.shard_map(tp, mesh=mesh,
-                        in_specs=(P(), P(), P(), P(), P()),
-                        out_specs=P(), check_vma=False)(x, w1, b1, w2, b2)
+    got = jax.jit(jax.shard_map(
+        tp, mesh=mesh, in_specs=(P(), P(), P(), P(), P()),
+        out_specs=P(), check_vma=False))(x, w1, b1, w2, b2)
     want = jax.nn.gelu(x @ w1 + b1) @ w2 + b2
     assert jnp.max(jnp.abs(got - want)) < TOL
 
@@ -52,8 +52,8 @@ def test_tp_mlp_helper_matches_dense():
         return tp_mlp(x, local_shard(w1, 1), None, local_shard(w2, 0),
                       None)
 
-    got = jax.shard_map(tp, mesh=mesh, in_specs=(P(),) * 3, out_specs=P(),
-                        check_vma=False)(x, w1, w2)
+    got = jax.jit(jax.shard_map(tp, mesh=mesh, in_specs=(P(),) * 3,
+                                out_specs=P(), check_vma=False))(x, w1, w2)
     want = jax.nn.gelu(x @ w1) @ w2
     assert jnp.max(jnp.abs(got - want)) < TOL
 
@@ -66,8 +66,8 @@ def test_column_parallel_gather_output():
     def tp(x, w):
         return column_parallel(x, local_shard(w, 1), gather_output=True)
 
-    got = jax.shard_map(tp, mesh=mesh, in_specs=(P(), P()),
-                        out_specs=P(), check_vma=False)(x, w)
+    got = jax.jit(jax.shard_map(tp, mesh=mesh, in_specs=(P(), P()),
+                                out_specs=P(), check_vma=False))(x, w)
     assert jnp.max(jnp.abs(got - w)) < TOL
 
 
@@ -81,8 +81,8 @@ def test_row_parallel_unsharded_input():
         return row_parallel(x, local_shard(w, 0),
                             input_is_parallel=False)
 
-    got = jax.shard_map(tp, mesh=mesh, in_specs=(P(), P()),
-                        out_specs=P(), check_vma=False)(x, w)
+    got = jax.jit(jax.shard_map(tp, mesh=mesh, in_specs=(P(), P()),
+                                out_specs=P(), check_vma=False))(x, w)
     assert jnp.max(jnp.abs(got - x @ w)) < TOL
 
 
@@ -98,8 +98,8 @@ def test_tp_gradients_match_dense():
         lambda x, w1, w2: tp_mlp(x, local_shard(w1, 1), None,
                                  local_shard(w2, 0), None),
         mesh=mesh, in_specs=(P(),) * 3, out_specs=P(), check_vma=False)
-    got = jax.grad(lambda w1, w2: jnp.sum(sm(x, w1, w2) ** 2),
-                   (0, 1))(w1, w2)
+    got = jax.jit(jax.grad(lambda w1, w2: jnp.sum(sm(x, w1, w2) ** 2),
+                           (0, 1)))(w1, w2)
     want = jax.grad(
         lambda w1, w2: jnp.sum((jax.nn.gelu(x @ w1) @ w2) ** 2),
         (0, 1))(w1, w2)

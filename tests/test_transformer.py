@@ -55,9 +55,10 @@ def test_parallel_forward_matches_single_device(axes_kw, mesh_kw,
         return logits
 
     out_spec = P(ax.data, ax.seq, None)
-    got = jax.shard_map(local, mesh=mesh, in_specs=(P(), batch_spec),
-                        out_specs=out_spec, check_vma=False)(params,
-                                                             tokens)
+    got = jax.jit(jax.shard_map(local, mesh=mesh,
+                                in_specs=(P(), batch_spec),
+                                out_specs=out_spec,
+                                check_vma=False))(params, tokens)
     want, _ = _single_device_logits(params, tokens)
     assert np.max(np.abs(np.asarray(got) - np.asarray(want))) < TOL
 
@@ -71,10 +72,10 @@ def test_pipeline_forward_matches_single_device():
         logits, aux = forward(params, tokens, CFG, ax)
         return logits
 
-    got = jax.shard_map(local, mesh=mesh,
-                        in_specs=(P(), P("data", None)),
-                        out_specs=P("data", None, None),
-                        check_vma=False)(params, tokens)
+    got = jax.jit(jax.shard_map(local, mesh=mesh,
+                                in_specs=(P(), P("data", None)),
+                                out_specs=P("data", None, None),
+                                check_vma=False))(params, tokens)
     want, _ = _single_device_logits(params, tokens)
     assert np.max(np.abs(np.asarray(got) - np.asarray(want))) < TOL
 
@@ -92,9 +93,9 @@ def test_moe_transformer_runs_and_is_finite():
     sm = jax.shard_map(loss_fn, mesh=mesh,
                        in_specs=(P(), P("data", None)), out_specs=P(),
                        check_vma=False)
-    loss = sm(params, (tokens, targets))
+    loss = jax.jit(sm)(params, (tokens, targets))
     assert bool(jnp.isfinite(loss))
-    grads = jax.grad(sm)(params, (tokens, targets))
+    grads = jax.jit(jax.grad(sm))(params, (tokens, targets))
     flat, _ = jax.tree_util.tree_flatten(grads)
     assert all(bool(jnp.all(jnp.isfinite(g))) for g in flat)
     # Expert + router weights actually receive gradient.
@@ -131,12 +132,12 @@ def test_parallel_gradients_match_single_device():
     sm = jax.shard_map(loss_fn, mesh=mesh,
                        in_specs=(P(), P("data", "seq")), out_specs=P(),
                        check_vma=False)
-    got = jax.grad(sm)(params, (tokens, targets))
+    got = jax.jit(jax.grad(sm))(params, (tokens, targets))
 
     single_loss = make_loss_fn(CFG, ParallelAxes(data=None),
                                mesh_axes=())
-    want = jax.grad(
-        lambda p: single_loss(p, (tokens, targets)))(params)
+    want = jax.jit(jax.grad(
+        lambda p: single_loss(p, (tokens, targets))))(params)
     flat_got, _ = jax.tree_util.tree_flatten(got)
     flat_want, _ = jax.tree_util.tree_flatten(want)
     for a, b in zip(flat_got, flat_want):
