@@ -12,8 +12,21 @@ Prints ONE JSON line:
 On persistent failure (e.g. the TPU tunnel is down) it still prints one
 structured JSON line with an ``error`` field instead of a traceback.
 
+MFU ceiling analysis (v5e, measured 2026-07, round 3):
+  * Pure chained 8192^3 bf16 matmuls on this chip/tunnel: 177.8 TFLOP/s
+    = 90% of the 197 TFLOP/s bf16 peak, so the environment itself is not
+    the cap.
+  * The ResNet-50 train step delivers ~60 TFLOP/s (XLA cost analysis) =
+    30% of peak / 34% of the achievable matmul rate.  Batch sweep
+    (64/128/192/256/512 → 2163/2528/2325/2493/2360 img/s) puts the
+    optimum at 128.  The residual gap is ResNet's structural profile on
+    MXU-class hardware: the 3-input-channel stem conv cannot fill the
+    128-lane systolic array, early layers have small channel depths, and
+    BN + elementwise chains are HBM-bound — consistent with the 30-40%
+    MFU commonly reported for ResNet-50 training on TPUs.
+
 Usage:
-  python bench.py            # full run (real TPU; batch 256, ~2 min)
+  python bench.py            # full run (real TPU; batch 128, ~2 min)
   python bench.py --smoke    # tiny shapes (CPU-friendly sanity check)
 """
 
@@ -157,7 +170,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for CPU sanity checks")
-    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--batch-size", type=int, default=128)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)

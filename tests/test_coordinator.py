@@ -18,13 +18,26 @@ from horovod_tpu.ops.wire import (DataType, Request, RequestType, Response,
 @pytest.fixture(params=["py", "native"])
 def make_coord(request):
     """Both coordinator implementations must pass the identical matrix —
-    the Python one is the executable spec for native/coordinator.cc."""
+    the Python one is the executable spec for native/coordinator.cc.
+    Yields a factory that closes every instance at teardown (the native
+    one owns a C++ allocation)."""
     if request.param == "native":
         if not (_native_lib.NATIVE
                 and hasattr(_native_lib.raw(), "hvd_coord_fetch_responses")):
             pytest.skip("native library not built")
-        return NativeCoordinator
-    return PyCoordinator
+        ctor = NativeCoordinator
+    else:
+        ctor = PyCoordinator
+    made = []
+
+    def factory(size, fusion_threshold):
+        c = ctor(size, fusion_threshold)
+        made.append(c)
+        return c
+
+    yield factory
+    for c in made:
+        c.close()
 
 
 def _req(rank, name, shape=(4,), op=RequestType.ALLREDUCE,
@@ -185,3 +198,4 @@ def test_py_native_response_parity_fuzz():
         nat_resps = nat.poll_responses(sizes_bytes)
         assert pack_response_list(py_resps) == pack_response_list(
             nat_resps), (trial, py_resps, nat_resps)
+        nat.close()
