@@ -1,0 +1,310 @@
+"""Keras frontend: the reference's ``horovod.keras`` API over the TPU
+runtime, targeting Keras 3 on the JAX backend (set ``KERAS_BACKEND=jax``
+before importing keras — the TPU-native combination).
+
+Re-creation of the reference surface (horovod/keras/__init__.py:29-160,
+horovod/keras/callbacks.py) with the TF-session plumbing replaced by the
+eager collective path of :mod:`..ops.collective` and, inside compiled
+training, the same dual-path reduction the optax
+:class:`~horovod_tpu.parallel.data.DistributedOptimizer` uses:
+
+* **eager** (custom training loops calling ``optimizer.apply`` /
+  ``apply_gradients`` with concrete arrays): gradients go through the
+  dynamic-path allreduce queue exactly like the reference's
+  ``get_gradients`` override (horovod/keras/__init__.py:43-65).
+* **compiled under shard_map** over the replica axis: fused ``lax.psum``
+  reduction.
+* **compiled under Keras's own jit** (``model.fit`` on the JAX backend,
+  with or without ``keras.distribution.DataParallel``): gradients of the
+  global batch are already synchronized by XLA's SPMD partitioner — the
+  TPU-native analogue of the allreduce — so they pass through unchanged.
+
+Usage parity::
+
+    import horovod_tpu.frontends.keras as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.01))
+    model.compile(optimizer=opt, loss="mse")
+    model.fit(x, y, callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ])
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..core import state as _state
+from ..core.state import (cross_rank, cross_size, init,  # noqa: F401
+                          is_initialized, local_rank, local_size,
+                          mpi_threads_supported, rank, shutdown, size)
+from ..ops import collective as _C
+from ..parallel import data as _D
+
+
+def _reduce_grads(grads, average: bool):
+    """Dual-path gradient reduction shared with the optax wrapper."""
+    leaves = [g for g in grads if g is not None]
+    if not leaves:
+        return grads
+    traced = any(isinstance(g, jax.core.Tracer) for g in leaves)
+    if traced:
+        if _D._in_replica_context():
+            red = iter(_D.allreduce_gradients(leaves, average=average))
+            return [next(red) if g is not None else None for g in grads]
+        if _state.is_initialized() and _state.global_state().multiprocess:
+            # N separate jitted programs cannot be synced by a pass-
+            # through; silent pass-through would train each process
+            # independently after the one-time broadcast.
+            import keras
+
+            if keras.distribution.distribution() is None:
+                raise RuntimeError(
+                    "model.fit in multi-process mode needs a global-batch "
+                    "SPMD program: set keras.distribution.set_distribution("
+                    "keras.distribution.DataParallel(...)) over the global "
+                    "devices (then XLA syncs gradients), or run the "
+                    "training loop eagerly so the allreduce queue can.")
+        # Keras's jitted train step: XLA's SPMD partitioner owns the
+        # cross-device sync (keras.distribution / sharded inputs).
+        return grads
+    if not _state.is_initialized():
+        raise _state.NotInitializedError()
+    if _state.size() <= 1:
+        return grads
+    red = iter(_D._eager_allreduce_grads(leaves, average=average))
+    return [next(red) if g is not None else None for g in grads]
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         average: bool = True):
+    """Wrap a ``keras.optimizers.Optimizer`` so gradients are averaged
+    across replicas before the update.
+
+    Same dynamic-subclass trick as the reference
+    (horovod/keras/__init__.py:86-91): the returned object is an instance
+    of a class with the wrapped optimizer's name and base class, so a
+    saved model restores without horovod_tpu installed.  Keras 3 funnels
+    every path — ``apply_gradients``, eager ``apply``, and the jitted
+    ``stateless_apply`` — through ``apply``, which is where the
+    reduction hooks in (the Keras-3 analogue of the reference's
+    ``get_gradients`` override).
+    """
+    import keras
+
+    base = optimizer.__class__
+
+    def _apply(self, grads, trainable_variables=None):
+        grads = _reduce_grads(list(grads), self._hvd_average)
+        return super(cls, self).apply(grads, trainable_variables)
+
+    cls = type(base.__name__, (base,),
+               {"apply": _apply, "_hvd_average": average,
+                "_hvd_name": name or f"Distributed{base.__name__}"})
+    config = optimizer.get_config()
+    return cls.from_config(config) if hasattr(cls, "from_config") \
+        else cls(**config)
+
+
+def broadcast_global_variables(model_or_variables, root_rank: int = 0):
+    """Broadcast all variables (model + optimizer) from ``root_rank``
+    (≙ horovod/keras/__init__.py:94-102, minus the TF session).  Accepts
+    a Keras model, an optimizer, or an iterable of ``keras.Variable``."""
+    variables = getattr(model_or_variables, "variables", None)
+    if variables is None:
+        variables = list(model_or_variables)
+    opt = getattr(model_or_variables, "optimizer", None)
+    if opt is not None:
+        variables = list(variables) + list(opt.variables)
+    handles = [
+        _C.broadcast_async(np.asarray(v), root_rank,
+                           name=f"broadcast.keras.{i}.{v.path}")
+        for i, v in enumerate(variables)
+    ]
+    for v, h in zip(variables, handles):
+        v.assign(np.asarray(_C.synchronize(h)))
+
+
+def allreduce(value, name: Optional[str] = None, average: bool = True):
+    """Allreduce a tensor-compatible value (≙ keras/__init__.py:105-118)."""
+    return np.asarray(_C.allreduce(np.asarray(value), average=average,
+                                   name=name))
+
+
+def allgather(value, name: Optional[str] = None):
+    return np.asarray(_C.allgather(np.asarray(value), name=name))
+
+
+def broadcast(value, root_rank: int, name: Optional[str] = None):
+    return np.asarray(_C.broadcast(np.asarray(value), root_rank,
+                                   name=name))
+
+
+# ---------------------------------------------------------------------------
+# Callbacks (≙ horovod/keras/callbacks.py)
+# ---------------------------------------------------------------------------
+
+def _make_callbacks():
+    import keras
+
+    class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+        """Broadcast initial variables from ``root_rank`` at train start
+        (≙ keras/callbacks.py:24-44)."""
+
+        def __init__(self, root_rank: int = 0):
+            super().__init__()
+            self.root_rank = root_rank
+            self.broadcast_done = False
+
+        def on_batch_begin(self, batch, logs=None):
+            if self.broadcast_done:
+                return
+            broadcast_global_variables(self.model, self.root_rank)
+            self.broadcast_done = True
+
+    class MetricAverageCallback(keras.callbacks.Callback):
+        """Average epoch metrics over all replicas before other callbacks
+        (checkpointing, early stopping) read them
+        (≙ keras/callbacks.py:47-70)."""
+
+        def on_epoch_end(self, epoch, logs=None):
+            if logs:
+                for k, v in list(logs.items()):
+                    if isinstance(v, (int, float, np.floating, np.integer)):
+                        logs[k] = float(allreduce(
+                            np.asarray(v, np.float32),
+                            name=f"metric.{k}.{epoch}"))
+
+    class LearningRateScheduleCallback(keras.callbacks.Callback):
+        """Multiply the initial LR by ``multiplier`` over
+        [start_epoch, end_epoch) (≙ keras/callbacks.py:73-129)."""
+
+        def __init__(self, multiplier, start_epoch: int = 0,
+                     end_epoch: Optional[int] = None, staircase: bool = True,
+                     momentum_correction: bool = True,
+                     steps_per_epoch: Optional[int] = None):
+            super().__init__()
+            self.multiplier = (multiplier if callable(multiplier)
+                               else (lambda epoch: multiplier))
+            self.start_epoch = start_epoch
+            self.end_epoch = end_epoch
+            if not staircase and keras.backend.backend() == "jax":
+                # The Keras JAX trainer runs each epoch from state captured
+                # at the first batch; mid-epoch variable writes never reach
+                # the jitted step.  Degrade to epoch-granular adjustment
+                # (documented deviation from the reference's per-batch
+                # ramp).
+                staircase = True
+            self.staircase = staircase
+            self.momentum_correction = momentum_correction
+            self.steps_per_epoch = steps_per_epoch
+            self.initial_lr = None
+            self.current_epoch = None
+            # (true momentum, lr at save time) — corrections are always
+            # computed from these so repeated adjustments cannot compound.
+            self._momentum_ref = None
+
+        def _autodetect_initial_lr(self):
+            if self.initial_lr is None:
+                self.initial_lr = float(
+                    np.asarray(self.model.optimizer.learning_rate))
+            return self.initial_lr
+
+        def _adjust(self, epoch):
+            old_lr = float(np.asarray(self.model.optimizer.learning_rate))
+            new_lr = self._autodetect_initial_lr() * self.multiplier(epoch)
+            self.model.optimizer.learning_rate = new_lr
+            if (self.momentum_correction
+                    and hasattr(self.model.optimizer, "momentum")
+                    and old_lr > 0):
+                # Momentum correction: scale the TRUE momentum by
+                # new_lr / lr_at_save (≙ keras/callbacks.py:104-116).
+                if self._momentum_ref is None:
+                    self._momentum_ref = (
+                        float(np.asarray(self.model.optimizer.momentum)),
+                        old_lr)
+                m0, lr0 = self._momentum_ref
+                self.model.optimizer.momentum = m0 * new_lr / lr0
+
+        def on_epoch_begin(self, epoch, logs=None):
+            self.current_epoch = epoch
+            if self.staircase and epoch >= self.start_epoch and (
+                    self.end_epoch is None or epoch < self.end_epoch):
+                self._adjust(epoch)
+
+        def on_batch_begin(self, batch, logs=None):
+            if self.staircase:
+                return
+            epoch = self.current_epoch or 0
+            if epoch >= self.start_epoch and (
+                    self.end_epoch is None or epoch < self.end_epoch):
+                steps = (self.steps_per_epoch
+                         or self.params.get("steps") or 1)
+                frac = epoch + float(batch) / max(1, steps)
+                self._adjust(frac)
+
+        def on_epoch_end(self, epoch, logs=None):
+            if self._momentum_ref is not None:
+                # Restore the true (uncorrected) momentum so checkpoints
+                # and get_config() never see the corrected value.
+                self.model.optimizer.momentum = self._momentum_ref[0]
+                self._momentum_ref = None
+            if logs is not None:
+                logs["lr"] = float(
+                    np.asarray(self.model.optimizer.learning_rate))
+
+    class LearningRateWarmupCallback(LearningRateScheduleCallback):
+        """Ramp LR from (initial / size) to initial * size-scaling over
+        ``warmup_epochs`` — the gradual-warmup recipe of the large-batch
+        paper the reference implements (≙ keras/callbacks.py:132-186)."""
+
+        def __init__(self, warmup_epochs: int = 5, momentum_correction: bool
+                     = True, steps_per_epoch: Optional[int] = None,
+                     verbose: int = 0):
+            self.warmup_epochs = warmup_epochs
+            self.verbose = verbose
+
+            def multiplier(progress):
+                # progress may be fractional (per-batch ramp on backends
+                # that support it) or the integer epoch (JAX backend);
+                # reaches exactly 1.0 at the end of warmup either way.
+                p = min(progress + 1, self.warmup_epochs)
+                return 1.0 / size() + p * (1.0 - 1.0 / size()) \
+                    / self.warmup_epochs
+
+            super().__init__(multiplier, start_epoch=0,
+                             end_epoch=warmup_epochs, staircase=False,
+                             momentum_correction=momentum_correction,
+                             steps_per_epoch=steps_per_epoch)
+
+        def on_epoch_end(self, epoch, logs=None):
+            super().on_epoch_end(epoch, logs)
+            if epoch == self.warmup_epochs - 1 and self.verbose and \
+                    rank() == 0:
+                print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                      f"warmup to {np.asarray(self.model.optimizer.learning_rate)}.")
+
+    return SimpleNamespace(
+        BroadcastGlobalVariablesCallback=BroadcastGlobalVariablesCallback,
+        MetricAverageCallback=MetricAverageCallback,
+        LearningRateScheduleCallback=LearningRateScheduleCallback,
+        LearningRateWarmupCallback=LearningRateWarmupCallback,
+    )
+
+
+# Lazy so `import horovod_tpu.frontends.keras` works before keras does.
+class _CallbacksModule:
+    _cached = None
+
+    def __getattr__(self, item):
+        if _CallbacksModule._cached is None:
+            _CallbacksModule._cached = _make_callbacks()
+        return getattr(_CallbacksModule._cached, item)
+
+
+callbacks = _CallbacksModule()
