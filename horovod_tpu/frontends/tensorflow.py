@@ -1,0 +1,212 @@
+"""TensorFlow frontend: the reference's ``horovod.tensorflow`` API over
+the TPU runtime, re-targeted at TF2 eager execution.
+
+The reference surface (horovod/tensorflow/__init__.py:49-192) is TF1
+graph ops: custom MPI kernels registered as TF ops, a
+``SessionRunHook``, and a ``tf.train.Optimizer`` wrapper.  Under TF2 the
+same capabilities map to eager tensors bridging through NumPy into the
+runtime's dynamic-path collective queue (the identical path the Torch
+frontend rides), plus:
+
+* :func:`allreduce` — dense tensors AND ``tf.IndexedSlices`` (the sparse
+  gather-of-(values, indices) branch, reference
+  tensorflow/__init__.py:67-78).
+* :class:`DistributedGradientTape` — the TF2-idiomatic replacement for
+  wrapping ``compute_gradients`` (reference DistributedOptimizer,
+  tensorflow/__init__.py:135-192): gradients are allreduced as they come
+  out of ``tape.gradient``.
+* :func:`DistributedOptimizer` — wraps a ``tf.keras`` optimizer so
+  ``apply_gradients`` reduces first (eager only; inside ``tf.function``
+  the cross-process queue cannot run — use DistributedGradientTape
+  outside the compiled region or the JAX surface for compiled training).
+* :func:`broadcast_variables` / :func:`broadcast_global_variables` — the
+  consistent-initialization broadcast (reference
+  BroadcastGlobalVariablesHook, tensorflow/__init__.py:100-130; TF2 has
+  no sessions, so this is a direct call).
+
+TPU note: TF does not drive the TPU here — JAX/XLA does.  This frontend
+exists so TF-based data/eval pipelines and models can participate in the
+same job (rank topology, collectives, validation, timeline) without a
+rewrite; compiled TPU training belongs to the JAX surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional
+
+import numpy as np
+
+from ..core import state as _state
+from ..core.state import (cross_rank, cross_size, init,  # noqa: F401
+                          is_initialized, local_rank, local_size,
+                          mpi_threads_supported, rank, shutdown, size)
+from ..ops import collective as _C
+from ..ops import sparse as _S
+
+
+def _tf():
+    import tensorflow as tf
+
+    return tf
+
+
+def _to_numpy(t) -> np.ndarray:
+    tf = _tf()
+    if isinstance(t, tf.Variable):
+        t = t.value()
+    if hasattr(t, "numpy"):
+        try:
+            return t.numpy()
+        except Exception as e:  # symbolic tensor inside tf.function
+            raise RuntimeError(
+                "horovod_tpu.frontends.tensorflow collectives run eagerly; "
+                "call them outside tf.function (or use the JAX surface for "
+                "compiled training).") from e
+    return np.asarray(t)
+
+
+def _wrap(out, like: np.ndarray):
+    """Result array → tf tensor with the caller's dtype preserved (the
+    JAX runtime has x64 disabled; cast back at the API boundary like the
+    Torch frontend does, torch.py:66-67)."""
+    tf = _tf()
+    return tf.constant(np.asarray(out).astype(like.dtype, copy=False))
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None):
+    """Allreduce a ``tf.Tensor``/``tf.Variable``/``tf.IndexedSlices``.
+
+    IndexedSlices dispatch to the sparse gather-of-(values, indices)
+    exchange exactly like the reference (tensorflow/__init__.py:67-78).
+    """
+    tf = _tf()
+    if isinstance(tensor, tf.IndexedSlices):
+        # dense_shape may legally be None; the exchange never needs it
+        # (it only gathers values + indices, like the reference).
+        dense_shape = (None if tensor.dense_shape is None
+                       else tuple(int(d) for d in tensor.dense_shape))
+        values = np.asarray(_to_numpy(tensor.values))
+        indices = np.asarray(_to_numpy(tensor.indices))
+        red = _S.allreduce(
+            _S.IndexedSlices(values=values, indices=indices,
+                             dense_shape=dense_shape or ()),
+            average=average, name=name)
+        return tf.IndexedSlices(
+            _wrap(red.values, values), _wrap(red.indices, indices),
+            dense_shape=None if dense_shape is None
+            else tf.constant(dense_shape, dtype="int64"))
+    arr = _to_numpy(tensor)
+    return _wrap(_C.allreduce(arr, average=average, name=name), arr)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    arr = _to_numpy(tensor)
+    return _wrap(_C.allgather(arr, name=name), arr)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    arr = _to_numpy(tensor)
+    return _wrap(_C.broadcast(arr, root_rank, name=name), arr)
+
+
+def broadcast_variables(variables: Iterable, root_rank: int = 0) -> None:
+    """Assign every variable the root's value — launch all broadcasts
+    async, then synchronize (the Torch frontend's pattern, matching the
+    reference's grouped bcast op, tensorflow/__init__.py:100-107)."""
+    variables = list(variables)
+    handles = [
+        _C.broadcast_async(_to_numpy(v), root_rank,
+                           name=f"broadcast.tf.{i}.{v.name}")
+        for i, v in enumerate(variables)
+    ]
+    for v, h in zip(variables, handles):
+        v.assign(np.asarray(_C.synchronize(h)))
+
+
+def broadcast_global_variables(model_or_variables, root_rank: int = 0):
+    """TF2 spelling of the reference's broadcast_global_variables: there
+    is no global-variables collection, so pass a model (``.variables``)
+    or an iterable of variables."""
+    variables = getattr(model_or_variables, "variables", model_or_variables)
+    broadcast_variables(variables, root_rank)
+
+
+class DistributedGradientTape:
+    """Wrap a ``tf.GradientTape`` so ``gradient()`` returns allreduced
+    gradients — the TF2 idiom for the reference's DistributedOptimizer
+    ``compute_gradients`` override (tensorflow/__init__.py:158-177)."""
+
+    def __init__(self, tape, average: bool = True):
+        self._tape = tape
+        self._average = average
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_tape"], item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, *args, **kwargs):
+        tf = _tf()
+        grads = self._tape.gradient(target, sources, *args, **kwargs)
+        flat = tf.nest.flatten(grads)
+        red = _allreduce_batch(flat, self._average, prefix="tape.grad")
+        return tf.nest.pack_sequence_as(grads, red)
+
+
+def _allreduce_batch(tensors, average: bool, prefix: str) -> List[Any]:
+    """Fire every allreduce async, then synchronize — so the runtime's
+    tensor fusion batches the small gradients into one collective
+    (ops/collective.py fused buckets) instead of N round trips."""
+    arrs = [None if t is None else _to_numpy(t) for t in tensors]
+    handles = [
+        None if a is None else _C.allreduce_async(
+            a, average=average, name=f"{prefix}.{i}")
+        for i, a in enumerate(arrs)
+    ]
+    return [
+        None if h is None else _wrap(_C.synchronize(h), arrs[i])
+        for i, h in enumerate(handles)
+    ]
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         average: bool = True):
+    """Wrap a ``tf.keras`` optimizer so ``apply_gradients`` allreduces
+    the gradients first (≙ reference DistributedOptimizer,
+    tensorflow/__init__.py:135-192, minus the TF1 graph machinery).
+    Same dynamic-subclass trick: the returned instance keeps the wrapped
+    class's name."""
+    base = optimizer.__class__
+    overrides = {"_hvd_average": average,
+                 "_hvd_name": name or f"Distributed{base.__name__}"}
+
+    if hasattr(base, "apply"):
+        # Keras-3-style optimizer (tf.keras in TF >= 2.16): every path —
+        # apply_gradients, eager apply, stateless_apply — funnels through
+        # apply(), so that is the one hook (same reasoning as
+        # frontends/keras.py).
+        def _apply(self, grads, trainable_variables=None):
+            red = _allreduce_batch(list(grads), self._hvd_average,
+                                   prefix="grad")
+            return super(cls, self).apply(red, trainable_variables)
+
+        overrides["apply"] = _apply
+    else:
+        # Legacy optimizer: apply_gradients is the entry point.
+        def _apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            red = _allreduce_batch([g for g, _ in gv], self._hvd_average,
+                                   prefix="grad")
+            return super(cls, self).apply_gradients(
+                [(r, v) for r, (_, v) in zip(red, gv)], *args, **kwargs)
+
+        overrides["apply_gradients"] = _apply_gradients
+
+    cls = type(base.__name__, (base,), overrides)
+    return cls.from_config(optimizer.get_config()) \
+        if hasattr(cls, "from_config") else cls(**optimizer.get_config())

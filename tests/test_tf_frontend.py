@@ -1,0 +1,97 @@
+"""TensorFlow frontend tests (≙ reference test/test_tensorflow.py,
+re-targeted at TF2 eager)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.frontends.tensorflow as hvdtf  # noqa: E402
+
+
+def test_allreduce_dense(hvd):
+    x = tf.constant([1.0, 2.0, 3.0])
+    out = hvdtf.allreduce(x, average=True)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0], rtol=1e-6)
+    out = hvdtf.allreduce(x, average=False)
+    np.testing.assert_allclose(out.numpy(),
+                               np.asarray(x) * hvdtf.size(), rtol=1e-6)
+
+
+def test_allreduce_indexed_slices(hvd):
+    sl = tf.IndexedSlices(values=tf.constant([[1.0, 2.0], [3.0, 4.0]]),
+                          indices=tf.constant([1, 5], dtype="int64"),
+                          dense_shape=tf.constant([8, 2], dtype="int64"))
+    out = hvdtf.allreduce(sl, average=True)
+    assert isinstance(out, tf.IndexedSlices)
+    # The gather multiplies the row count by size(); densifying the
+    # averaged duplicates recovers the original represented tensor
+    # (reference semantics, tensorflow/__init__.py:67-78).
+    assert out.values.shape[0] == 2 * hvd.size()
+    dense = np.zeros((8, 2), "float32")
+    np.add.at(dense, out.indices.numpy(), out.values.numpy())
+    want = np.zeros((8, 2), "float32")
+    want[1] = [1.0, 2.0]
+    want[5] = [3.0, 4.0]
+    np.testing.assert_allclose(dense, want, rtol=1e-5)
+
+
+def test_allgather_and_broadcast(hvd):
+    x = tf.constant([[1.0, 2.0]])
+    out = hvdtf.allgather(x)
+    assert out.shape == (hvd.size(), 2)
+    out = hvdtf.broadcast(tf.constant([7.0]), root_rank=0)
+    np.testing.assert_allclose(out.numpy(), [7.0], rtol=1e-6)
+
+
+def test_broadcast_variables(hvd):
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable([[3.0]])
+    before = [v1.numpy().copy(), v2.numpy().copy()]
+    hvdtf.broadcast_variables([v1, v2], root_rank=0)
+    np.testing.assert_allclose(v1.numpy(), before[0], rtol=1e-6)
+    np.testing.assert_allclose(v2.numpy(), before[1], rtol=1e-6)
+
+
+def test_distributed_gradient_tape(hvd):
+    w = tf.Variable([2.0])
+    with hvdtf.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = w * w
+    (g,) = tape.gradient(loss, [w])
+    np.testing.assert_allclose(np.asarray(g), [4.0], rtol=1e-6)
+
+
+def test_distributed_optimizer_applies_reduced_grads(hvd):
+    opt = hvdtf.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0))
+    assert opt.__class__.__name__ == "SGD"
+    v = tf.Variable([0.0, 0.0])
+    opt.apply_gradients([(tf.constant([1.0, 2.0]), v)])
+    np.testing.assert_allclose(v.numpy(), [-1.0, -2.0], rtol=1e-6)
+
+
+def test_collectives_inside_tf_function_raise(hvd):
+    @tf.function
+    def f(x):
+        return hvdtf.allreduce(x)
+
+    with pytest.raises(Exception, match="eagerly|numpy"):
+        f(tf.constant([1.0]))
+
+
+def test_dtype_preserved_float64_int64(hvd):
+    x = tf.constant([1.0, 2.0], dtype=tf.float64)
+    out = hvdtf.allreduce(x, average=True)
+    assert out.dtype == tf.float64
+    i = tf.constant([1, 2], dtype=tf.int64)
+    out = hvdtf.allgather(i)
+    assert out.dtype == tf.int64
+
+
+def test_indexed_slices_without_dense_shape(hvd):
+    sl = tf.IndexedSlices(values=tf.constant([[1.0]]),
+                          indices=tf.constant([0], dtype="int64"))
+    out = hvdtf.allreduce(sl, average=False)
+    assert isinstance(out, tf.IndexedSlices)
+    assert out.dense_shape is None
+    assert out.indices.dtype == tf.int64
