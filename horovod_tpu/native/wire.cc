@@ -34,6 +34,8 @@ const char* DataTypeName(DataType t) {
     case DataType::kBool: return "bool";
     case DataType::kBfloat16: return "bfloat16";
     case DataType::kFloat16: return "float16";
+    case DataType::kUint32: return "uint32";
+    case DataType::kUint64: return "uint64";
   }
   return "unknown";
 }

@@ -17,6 +17,7 @@ namespace hvdtpu {
 enum class DataType : uint8_t {
   kUint8 = 0, kInt8 = 1, kUint16 = 2, kInt16 = 3, kInt32 = 4, kInt64 = 5,
   kFloat32 = 6, kFloat64 = 7, kBool = 8, kBfloat16 = 9, kFloat16 = 10,
+  kUint32 = 11, kUint64 = 12,
 };
 
 const char* DataTypeName(DataType t);

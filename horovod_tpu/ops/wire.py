@@ -43,6 +43,8 @@ class DataType(IntEnum):
     BOOL = 8
     BFLOAT16 = 9
     FLOAT16 = 10
+    UINT32 = 11
+    UINT64 = 12
 
 
 _NP_TO_DTYPE = {
@@ -56,6 +58,8 @@ _NP_TO_DTYPE = {
     np.dtype(np.float64): DataType.FLOAT64,
     np.dtype(np.bool_): DataType.BOOL,
     np.dtype(np.float16): DataType.FLOAT16,
+    np.dtype(np.uint32): DataType.UINT32,
+    np.dtype(np.uint64): DataType.UINT64,
 }
 
 _DTYPE_SIZE = {
@@ -63,6 +67,7 @@ _DTYPE_SIZE = {
     DataType.INT16: 2, DataType.INT32: 4, DataType.INT64: 8,
     DataType.FLOAT32: 4, DataType.FLOAT64: 8, DataType.BOOL: 1,
     DataType.BFLOAT16: 2, DataType.FLOAT16: 2,
+    DataType.UINT32: 4, DataType.UINT64: 8,
 }
 
 

@@ -199,3 +199,17 @@ def test_py_native_response_parity_fuzz():
         assert pack_response_list(py_resps) == pack_response_list(
             nat_resps), (trial, py_resps, nat_resps)
         nat.close()
+
+
+def test_wire_uint32_uint64_roundtrip():
+    """Keras seed-generator variables are uint32; the wire and both
+    coordinators must carry the extended dtypes."""
+    r = Request(0, RequestType.BROADCAST, DataType.UINT32, "seed",
+                root_rank=0, tensor_shape=(2,))
+    r2, _ = Request.unpack(r.pack())
+    assert r2.tensor_type == DataType.UINT32
+    import numpy as np_
+    from horovod_tpu.ops import wire as W
+    assert W.dtype_of(np_.dtype(np_.uint32)) == DataType.UINT32
+    assert W.dtype_of(np_.dtype(np_.uint64)) == DataType.UINT64
+    assert W.dtype_size(DataType.UINT64) == 8
