@@ -163,7 +163,8 @@ def test_py_native_response_parity_fuzz():
             and hasattr(_native_lib.raw(), "hvd_coord_fetch_responses")):
         pytest.skip("native library not built")
     rng = np.random.RandomState(0)
-    dtypes = [DataType.FLOAT32, DataType.INT32, DataType.BFLOAT16]
+    dtypes = [DataType.FLOAT32, DataType.INT32, DataType.BFLOAT16,
+              DataType.UINT32, DataType.UINT64]
     ops = [RequestType.ALLREDUCE, RequestType.ALLGATHER,
            RequestType.BROADCAST]
     for trial in range(30):
@@ -201,15 +202,24 @@ def test_py_native_response_parity_fuzz():
         nat.close()
 
 
-def test_wire_uint32_uint64_roundtrip():
-    """Keras seed-generator variables are uint32; the wire and both
-    coordinators must carry the extended dtypes."""
+def test_wire_uint32_uint64_roundtrip(make_coord):
+    """Keras seed-generator variables are uint32; the wire and BOTH
+    coordinator implementations must carry the extended dtypes."""
+    from horovod_tpu.ops.wire import dtype_of, dtype_size
+
     r = Request(0, RequestType.BROADCAST, DataType.UINT32, "seed",
                 root_rank=0, tensor_shape=(2,))
     r2, _ = Request.unpack(r.pack())
     assert r2.tensor_type == DataType.UINT32
-    import numpy as np_
-    from horovod_tpu.ops import wire as W
-    assert W.dtype_of(np_.dtype(np_.uint32)) == DataType.UINT32
-    assert W.dtype_of(np_.dtype(np_.uint64)) == DataType.UINT64
-    assert W.dtype_size(DataType.UINT64) == 8
+    assert dtype_of(np.dtype(np.uint32)) == DataType.UINT32
+    assert dtype_of(np.dtype(np.uint64)) == DataType.UINT64
+    assert dtype_size(DataType.UINT64) == 8
+    # Drive a uint32 negotiation through the coordinator, including the
+    # mismatch error message (exercises native DataTypeName).
+    c = make_coord(2, 0)
+    c.submit(_req(0, "seed.t", dtype=DataType.UINT32))
+    c.submit(_req(1, "seed.t", dtype=DataType.UINT64))
+    resps = c.poll_responses({"seed.t": 8})
+    assert resps[0].response_type == ResponseType.ERROR
+    assert "uint32" in resps[0].error_message
+    assert "uint64" in resps[0].error_message
