@@ -79,6 +79,20 @@ class ParallelConfig:
         return sizes
 
 
+def _resolve(config, devices, degrees):
+    if config is None:
+        config = ParallelConfig(**degrees)
+    elif degrees:
+        raise TypeError("pass either a ParallelConfig or keyword degrees, "
+                        "not both")
+    devs = list(devices if devices is not None else jax.devices())
+    if config.device_count != len(devs):
+        raise ValueError(
+            f"parallel config {config} needs {config.device_count} devices "
+            f"but {len(devs)} were provided")
+    return config, devs
+
+
 def make_mesh(config: Optional[ParallelConfig] = None,
               devices: Optional[Sequence] = None,
               **degrees) -> jax.sharding.Mesh:
@@ -92,20 +106,68 @@ def make_mesh(config: Optional[ParallelConfig] = None,
     so the same model code works at any configuration.  Devices default to
     ``jax.devices()``; their count must equal the product of the degrees.
     """
-    if config is None:
-        config = ParallelConfig(**degrees)
-    elif degrees:
-        raise TypeError("pass either a ParallelConfig or keyword degrees, "
-                        "not both")
-    devs = list(devices if devices is not None else jax.devices())
-    if config.device_count != len(devs):
-        raise ValueError(
-            f"parallel config {config} needs {config.device_count} devices "
-            f"but {len(devs)} were provided")
+    config, devs = _resolve(config, devices, degrees)
     sizes = config.axis_sizes()
     names = tuple(a for a in _AXIS_ORDER if a in sizes)
     shape = tuple(sizes[a] for a in names)
     arr = np.asarray(devs).reshape(shape)
+    return jax.sharding.Mesh(arr, names)
+
+
+def make_hybrid_mesh(config: Optional[ParallelConfig] = None,
+                     devices: Optional[Sequence] = None,
+                     dcn_axes: Tuple[str, ...] = (DATA_AXIS,),
+                     **degrees) -> jax.sharding.Mesh:
+    """Build a mesh for a multi-slice (DCN-connected) TPU deployment.
+
+    On a multi-slice pod, chips within a slice talk over ICI; slices talk
+    over DCN.  The scaling recipe is to put the gradient-sync axes
+    (``data``, and ``pipe`` when microbatches amortize it) across DCN —
+    they communicate once per step — and keep every per-layer axis
+    (``model``/``seq``/``expert``) inside a slice on ICI.  This wraps
+    ``jax.experimental.mesh_utils.create_hybrid_device_mesh`` so the
+    device order actually honors that placement; on single-slice (or CPU
+    test) topologies it degrades to :func:`make_mesh` unchanged.
+
+    ``dcn_axes`` lists the axes to lay across slices (outermost first).
+    A DCN axis whose degree exceeds its share of the slice count is split
+    between DCN and ICI — e.g. 2 slices x 4 chips with ``data=4, model=2``
+    puts a 2-way data factor across DCN and a 2-way data factor on ICI
+    inside each slice (the standard multi-slice DP recipe).
+    """
+    import math
+
+    config, devs = _resolve(config, devices, degrees)
+
+    num_slices = len({getattr(d, "slice_index", 0) for d in devs})
+    if num_slices <= 1:
+        return make_mesh(config, devices=devs)
+
+    sizes = config.axis_sizes()
+    names = tuple(a for a in _AXIS_ORDER if a in sizes)
+    for a in dcn_axes:
+        if a not in names:
+            raise ValueError(f"dcn axis {a!r} not in mesh axes {names}")
+    # Split each DCN axis's degree into (cross-slice, in-slice) factors,
+    # outermost first, until the slices are exactly tiled.
+    remaining = num_slices
+    dcn_factor = {}
+    for a in dcn_axes:
+        f = math.gcd(sizes[a], remaining)
+        dcn_factor[a] = f
+        remaining //= f
+    if remaining != 1:
+        raise ValueError(
+            f"DCN axes {dcn_axes} with degrees "
+            f"{[sizes[a] for a in dcn_axes]} cannot tile {num_slices} "
+            f"slices; the cross-slice axes must tile the slices exactly.")
+    from jax.experimental import mesh_utils
+
+    mesh_shape = [sizes[a] // dcn_factor.get(a, 1) for a in names]
+    dcn_shape = [dcn_factor.get(a, 1) for a in names]
+    arr = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape, dcn_shape, devices=devs,
+        allow_split_physical_axes=True)
     return jax.sharding.Mesh(arr, names)
 
 

@@ -60,3 +60,70 @@ def test_validate_mesh():
     with pytest.raises(ValueError, match="missing required"):
         T.validate_mesh(mesh, (T.EXPERT_AXIS,))
     T.validate_mesh(mesh, (T.DATA_AXIS, T.MODEL_AXIS))
+
+
+def test_hybrid_mesh_falls_back_on_single_slice(hvd):
+    """CPU devices report no slice_index → single slice → plain mesh."""
+    from horovod_tpu.core.topology import make_hybrid_mesh, make_mesh
+
+    got = make_hybrid_mesh(data=2, model=4)
+    want = make_mesh(data=2, model=4)
+    assert got.axis_names == want.axis_names
+    assert got.devices.shape == want.devices.shape
+    assert [d.id for d in got.devices.flat] == \
+        [d.id for d in want.devices.flat]
+
+
+class _FakeDev:
+    def __init__(self, i, s):
+        self.id = i
+        self.slice_index = s
+        self.process_index = s
+        self.platform = "tpu"
+        self.device_kind = "faketpu"
+        self.coords = (i % 4, 0, 0)
+        self.core_on_chip = 0
+
+    def __repr__(self):
+        return f"FakeDev({self.id}, slice={self.slice_index})"
+
+
+def test_hybrid_mesh_places_ici_axes_within_slices(hvd):
+    """2 fake slices x 4 chips, data=2 x model=4: every model group (the
+    per-layer ICI axis) must live inside one slice; the data axis crosses
+    slices."""
+    from horovod_tpu.core.topology import make_hybrid_mesh
+
+    devs = [_FakeDev(i, i // 4) for i in range(8)]
+    mesh = make_hybrid_mesh(data=2, model=4, devices=devs)
+    assert mesh.axis_names == ("data", "pipe", "seq", "model")
+    arr = mesh.devices.reshape(2, 4)  # [data, model]
+    for d in range(2):
+        slices = {dev.slice_index for dev in arr[d]}
+        assert len(slices) == 1, f"model group crosses slices: {arr[d]}"
+
+
+def test_hybrid_mesh_splits_dcn_axis_between_dcn_and_ici(hvd):
+    """data=4 over 2 slices x 4 chips: a 2-way data factor crosses DCN and
+    a 2-way factor stays on ICI (the standard multi-slice DP recipe)."""
+    from horovod_tpu.core.topology import make_hybrid_mesh
+
+    devs = [_FakeDev(i, i // 4) for i in range(8)]
+    mesh = make_hybrid_mesh(data=4, model=2, devices=devs)
+    arr = mesh.devices.reshape(4, 2)  # [data, model]
+    for d in range(4):
+        slices = {dev.slice_index for dev in arr[d]}
+        assert len(slices) == 1, f"model group crosses slices: {arr[d]}"
+
+
+def test_hybrid_mesh_validates_dcn_axes(hvd):
+    from horovod_tpu.core.topology import make_hybrid_mesh
+
+    devs = [_FakeDev(i, i // 4) for i in range(12)]  # 3 fake slices
+    with pytest.raises(ValueError, match="tile the slices"):
+        make_hybrid_mesh(data=4, model=3, devices=devs,
+                         dcn_axes=("data",))
+    devs8 = [_FakeDev(i, i // 4) for i in range(8)]
+    with pytest.raises(ValueError, match="not in mesh axes"):
+        make_hybrid_mesh(data=2, model=4, devices=devs8,
+                         dcn_axes=("expert",))
