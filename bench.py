@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import traceback
@@ -57,8 +58,6 @@ RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.09e9
 
 
 def _chip_peak_flops() -> float | None:
-    import os
-
     try:
         import jax
 
@@ -176,53 +175,101 @@ def main() -> int:
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--attempts", type=int, default=3,
                     help="retries around backend init/compile flakes")
+    ap.add_argument("--attempt-timeout", type=float, default=600.0,
+                    help="seconds per attempt before the child is killed "
+                         "(the TPU tunnel can hang without raising)")
+    ap.add_argument("--no-space-to-depth", dest="space_to_depth",
+                    action="store_false", default=True,
+                    help="disable the MLPerf space-to-depth stem")
+    ap.add_argument("--_inner", action="store_true",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
 
-    last_err = None
+    if not args._inner:
+        return _supervise(args)
+
+    # Child: one attempt, structured output either way.  The parent owns
+    # retries and the kill-on-hang watchdog (the tunnel can wedge inside
+    # a C call where no Python exception ever surfaces).
+    try:
+        if args.smoke:
+            from horovod_tpu.models.resnet import ResNet18Thin
+
+            result = run(batch_size=8, image_size=32, warmup=1, iters=3,
+                         model_ctor=ResNet18Thin, num_classes=16)
+        else:
+            import functools
+
+            from horovod_tpu.models.resnet import ResNet50
+
+            ctor = functools.partial(
+                ResNet50, space_to_depth=args.space_to_depth)
+            result = run(batch_size=args.batch_size,
+                         image_size=args.image_size,
+                         warmup=args.warmup, iters=args.iters,
+                         model_ctor=ctor)
+    except Exception as e:  # noqa: BLE001 — structured failure output
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        return 1
+    value = result.pop("value")
+    out = {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+    }
+    out.update(result)
+    print(json.dumps(out))
+    return 0
+
+
+def _supervise(args) -> int:
+    """Run attempts in killable child processes; emit ONE JSON line."""
+    import subprocess
+
+    last_err = "unknown"
     for attempt in range(args.attempts):
+        cmd = [sys.executable, os.path.abspath(__file__), "--_inner",
+               "--batch-size", str(args.batch_size),
+               "--image-size", str(args.image_size),
+               "--iters", str(args.iters), "--warmup", str(args.warmup)]
+        if args.smoke:
+            cmd.append("--smoke")
+        if not args.space_to_depth:
+            cmd.append("--no-space-to-depth")
+        timed_out = False
         try:
-            if args.smoke:
-                from horovod_tpu.models.resnet import ResNet18Thin
-
-                result = run(batch_size=8, image_size=32, warmup=1, iters=3,
-                             model_ctor=ResNet18Thin, num_classes=16)
-            else:
-                result = run(batch_size=args.batch_size,
-                             image_size=args.image_size,
-                             warmup=args.warmup, iters=args.iters)
-            value = result.pop("value")
-            out = {
-                "metric": "resnet50_images_per_sec_per_chip",
-                "value": round(value, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(
-                    value / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
-            }
-            out.update(result)
-            print(json.dumps(out))
+            proc = subprocess.run(cmd, stdout=subprocess.PIPE,
+                                  timeout=args.attempt_timeout)
+            stdout, rc = proc.stdout, proc.returncode
+        except subprocess.TimeoutExpired as e:
+            # The child may have completed the measurement and printed its
+            # result before wedging at exit in the tunnel — salvage it.
+            timed_out = True
+            stdout, rc = e.stdout or b"", 0
+        lines = [ln for ln in stdout.decode(errors="replace").splitlines()
+                 if ln.strip().startswith("{")]
+        payload = None
+        for ln in reversed(lines):
+            try:
+                payload = json.loads(ln)
+                break
+            except json.JSONDecodeError:
+                continue
+        if rc == 0 and payload and payload.get("value") is not None:
+            print(json.dumps(payload))
             return 0
-        except Exception as e:  # noqa: BLE001 — structured failure output
-            last_err = e
-            traceback.print_exc(file=sys.stderr)
-            try:
-                import horovod_tpu as hvd
-
-                hvd.shutdown()
-            except Exception:
-                pass
-            try:
-                # Backend discovery failures are cached per process; clear
-                # so the next attempt re-dials the TPU tunnel.
-                import jax
-
-                jax.clear_backends()
-            except Exception:
-                pass
-            if attempt + 1 < args.attempts:
-                delay = 10 * (attempt + 1)
-                print(f"bench attempt {attempt + 1} failed ({e!r}); "
-                      f"retrying in {delay}s", file=sys.stderr)
-                time.sleep(delay)
+        if timed_out:
+            last_err = (f"attempt timed out after "
+                        f"{args.attempt_timeout:.0f}s (TPU tunnel hang?)")
+        else:
+            last_err = (payload or {}).get(
+                "error", f"child exited rc={rc} without a result")
+        print(f"bench attempt {attempt + 1} failed: {last_err}",
+              file=sys.stderr)
+        if attempt + 1 < args.attempts:
+            time.sleep(10 * (attempt + 1))
 
     # Persistent failure: one parseable JSON line, not a traceback.
     print(json.dumps({
@@ -230,7 +277,7 @@ def main() -> int:
         "value": None,
         "unit": "images/sec/chip",
         "vs_baseline": None,
-        "error": f"{type(last_err).__name__}: {last_err}",
+        "error": last_err,
         "attempts": args.attempts,
     }))
     return 1

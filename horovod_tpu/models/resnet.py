@@ -54,6 +54,12 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     compute_dtype: Any = jnp.bfloat16
+    # MLPerf space-to-depth stem: a 3-input-channel 7x7 conv cannot fill
+    # the 128-lane MXU; rearranging 2x2 pixel blocks into 12 channels and
+    # convolving 4x4/s1 computes the same stage (equivalent to a
+    # zero-padded 8x8/s2 conv, a superset of the 7x7) with 4x the MXU
+    # input-channel occupancy.
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -63,7 +69,21 @@ class ResNet(nn.Module):
                        momentum=0.9, epsilon=1e-5, dtype=self.compute_dtype,
                        param_dtype=jnp.float32, axis_name=None)
         x = x.astype(self.compute_dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        if self.space_to_depth:
+            b, h, w, c = x.shape
+            if h % 2 or w % 2:
+                # Pad odd extents so the 2x2 block rearrange is defined
+                # (SAME-conv tolerance, matching the 7x7/s2 stem).
+                x = jnp.pad(x, ((0, 0), (0, h % 2), (0, w % 2), (0, 0)))
+                b, h, w, c = x.shape
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                b, h // 2, w // 2, 4 * c)
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     name="conv_init")(x)
         x = nn.relu(norm(name="bn_init")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, block_count in enumerate(self.stage_sizes):

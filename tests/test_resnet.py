@@ -60,3 +60,18 @@ def test_resnet_training_converges_on_tiny_task(hvd):
         if first is None:
             first = float(loss)
     assert float(loss) < first
+
+
+def test_space_to_depth_stem_trains(hvd):
+    """The MLPerf space-to-depth stem variant: same output contract, the
+    stem conv sees 12 input channels instead of 3."""
+    model = ResNet18Thin(num_classes=4, space_to_depth=True)
+    params, stats = init_resnet(model, image_size=32, batch_size=8)
+    assert params["conv_init"]["kernel"].shape == (4, 4, 12, 16)
+    loss_fn = resnet_loss_fn(model)
+    opt = optax.sgd(0.1)
+    step = make_train_step_with_state(loss_fn, opt, donate=False)
+    images, labels = synthetic_imagenet(16, image_size=32, num_classes=4)
+    batch = shard_batch((jnp.asarray(images), jnp.asarray(labels)))
+    _, _, _, loss = step(params, stats, opt.init(params), batch)
+    assert np.isfinite(float(loss))
