@@ -101,6 +101,11 @@ class ControllerTransport:
         self.coordinator = coordinator
         self.num_processes = num_processes
         self.shutdown_requested = threading.Event()
+        # Ranks whose connection dropped without a SHUTDOWN frame — i.e.
+        # the process died (SURVEY §5 failure detection; the reference can
+        # only hang or MPI-abort here).
+        self.lost_ranks: set = set()
+        self._closing = False
         self._conns: Dict[int, socket.socket] = {}
         self._lock = threading.Lock()
         self._send_lock = threading.Lock()
@@ -156,9 +161,14 @@ class ControllerTransport:
             try:
                 ftype, payload = _recv_frame(conn)
             except OSError:
-                return  # worker died mid-frame / reset the connection
+                ftype = None  # worker died mid-frame / reset the conn
             if ftype is None:
-                return  # worker disconnected
+                # EOF without a SHUTDOWN frame = the worker terminated
+                # unexpectedly; the drain loop will poison pending ops.
+                if not (self.shutdown_requested.is_set() or self._closing):
+                    with self._lock:
+                        self.lost_ranks.add(rank)
+                return
             if ftype == FRAME_REQUEST:
                 req, _ = Request.unpack(payload)
                 try:
@@ -192,6 +202,7 @@ class ControllerTransport:
         return None  # responses come from the coordinator on rank 0
 
     def close(self) -> None:
+        self._closing = True
         with self._lock:
             conns = list(self._conns.values())
             self._conns.clear()
@@ -212,6 +223,7 @@ class WorkerTransport:
                  connect_timeout: float = 60.0):
         self.rank = rank
         self.shutdown_received = threading.Event()
+        self._closing = False
         self._responses: "queue.Queue[List[Response]]" = queue.Queue()
         deadline = time.monotonic() + connect_timeout
         last_err: Optional[Exception] = None
@@ -249,9 +261,19 @@ class WorkerTransport:
             try:
                 ftype, payload = _recv_frame(self._sock)
             except OSError:
-                return
+                ftype = None
             if ftype is None:
-                return  # controller gone
+                # Controller connection lost: if this wasn't a clean
+                # shutdown, surface it as a synthetic SHUTDOWN response so
+                # pending ops fail with a diagnosis instead of hanging
+                # (mirror of the controller's dead-worker detection).
+                if not (self.shutdown_received.is_set() or self._closing):
+                    self._responses.put([Response(
+                        ResponseType.SHUTDOWN,
+                        error_message="Horovod has been shut down: the "
+                        "rank-0 controller's connection was lost (the "
+                        "process died?) while collectives were pending.")])
+                return
             if ftype == FRAME_RESPONSES:
                 resps = wire.unpack_response_list(payload)
                 # Controller-initiated shutdown arrives as a SHUTDOWN-type
@@ -278,6 +300,7 @@ class WorkerTransport:
             return None
 
     def close(self) -> None:
+        self._closing = True
         try:
             self._sock.close()
         except OSError:

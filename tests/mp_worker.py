@@ -112,6 +112,27 @@ def scenario_shutdown(hvd):
         print(f"SHUTDOWN_OK rank={rank}")
 
 
+def scenario_dead_worker(hvd):
+    import jax.numpy as jnp
+
+    from horovod_tpu import HorovodError
+
+    rank = hvd.rank()
+    if rank == 0:
+        h = hvd.allreduce_async(jnp.ones((2,)), name="orphaned.op",
+                                average=False)
+        try:
+            hvd.synchronize(h)
+        except HorovodError as e:
+            assert "terminated unexpectedly" in str(e), str(e)
+            print(f"DEADWORKER_OK rank={rank}")
+            return
+        raise AssertionError("dead worker was not detected")
+    else:
+        time.sleep(1.0)
+        os._exit(0)  # die without any shutdown handshake
+
+
 def main():
     scenario = sys.argv[1]
     import horovod_tpu as hvd
