@@ -133,6 +133,32 @@ def scenario_dead_worker(hvd):
         os._exit(0)  # die without any shutdown handshake
 
 
+def scenario_checkpoint(hvd):
+    import jax.numpy as jnp
+
+    from horovod_tpu.utils.checkpoint import (restore_checkpoint,
+                                              resume_epoch,
+                                              save_checkpoint)
+
+    rank = hvd.rank()
+    path = os.environ["HVD_TPU_TEST_CKPT"]
+    good = {"w": np.full((3,), 7.0, "float32")}
+    if rank == 0:
+        assert save_checkpoint(path, good, step=5)
+    else:
+        # Non-root never writes (reference rank-0 convention).
+        assert not save_checkpoint(path, {"w": np.zeros((3,))}, step=5)
+    while not os.path.exists(path):
+        time.sleep(0.05)
+    # Each rank starts from divergent state; restore must converge all
+    # ranks to root's values via the broadcast.
+    mine = {"w": jnp.full((3,), float(rank + 1))}
+    restored = restore_checkpoint(path, mine)
+    np.testing.assert_allclose(np.asarray(restored["w"]), 7.0)
+    assert resume_epoch(path) == 5
+    print(f"CKPT_OK rank={rank}")
+
+
 def main():
     scenario = sys.argv[1]
     import horovod_tpu as hvd

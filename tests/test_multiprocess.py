@@ -74,3 +74,25 @@ def test_dead_worker_fails_pending_ops_with_rank():
     out = _launch("dead_worker", expect_rc0=False)
     assert "DEADWORKER_OK rank=0" in out
     assert "terminated unexpectedly" in out  # controller's stderr report
+
+
+@pytest.mark.slow
+def test_two_process_checkpoint_restore_and_resume(tmp_path):
+    out = _launch("checkpoint",
+                  extra_env={"HVD_TPU_TEST_CKPT": str(tmp_path / "ck.msgpack")})
+    assert "CKPT_OK rank=0" in out
+    assert "CKPT_OK rank=1" in out
+
+
+@pytest.mark.slow
+def test_two_process_timeline_records_negotiation(tmp_path):
+    import json as _json
+
+    tl = tmp_path / "timeline.json"
+    out = _launch("basic", extra_env={"HOROVOD_TIMELINE": str(tl)})
+    assert "BASIC_OK rank=0" in out
+    text = tl.read_text()
+    events = _json.loads(text if text.rstrip().endswith("]")
+                         else text.rstrip().rstrip(",") + "]")
+    names = {e.get("name") for e in events if isinstance(e, dict)}
+    assert any("NEGOTIATE" in (n or "") for n in names), sorted(names)[:20]
