@@ -1,0 +1,44 @@
+"""Guard the driver-facing bench artifact: `python bench.py --smoke` must
+emit exactly one parseable JSON line with the contract fields, whatever
+else happens (the driver records this output verbatim)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_smoke_emits_contract_json():
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        env=env, cwd=REPO, capture_output=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    lines = [ln for ln in proc.stdout.decode().splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1, proc.stdout.decode()
+    payload = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in payload, payload
+    assert payload["value"] is not None and payload["value"] > 0
+
+
+@pytest.mark.slow
+def test_bench_failure_still_emits_contract_json():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "bogus"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         "--attempts", "1"],
+        env=env, cwd=REPO, capture_output=True, timeout=180)
+    assert proc.returncode == 1
+    lines = [ln for ln in proc.stdout.decode().splitlines()
+             if ln.strip().startswith("{")]
+    payload = json.loads(lines[-1])
+    assert payload["value"] is None
+    assert "error" in payload

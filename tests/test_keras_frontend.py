@@ -126,3 +126,15 @@ def test_momentum_correction_restores_true_momentum(hvd):
     # The true momentum is restored at every epoch end: no drift.
     assert float(np.asarray(model.optimizer.momentum)) == pytest.approx(
         0.9, rel=1e-6)
+
+
+def test_distributed_optimizer_config_roundtrip(hvd):
+    """get_config/from_config survive the dynamic subclass, so a model
+    compiled with the wrapper saves and reloads (the reference names the
+    subclass after the wrapped optimizer for exactly this)."""
+    opt = hvdk.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.3, momentum=0.7))
+    cfg = opt.get_config()
+    clone = keras.optimizers.SGD.from_config(cfg)  # restores WITHOUT hvd
+    assert float(np.asarray(clone.learning_rate)) == pytest.approx(0.3)
+    assert clone.momentum == pytest.approx(0.7)
