@@ -58,6 +58,11 @@ def cluster_spec_from_env() -> Optional[ClusterSpec]:
                        process_id=int(pid))
 
 
+# Set by disarm_distributed_shutdown: a peer died, the jax.distributed
+# client was abandoned, and this process can only exit.
+_disarmed = False
+
+
 def maybe_initialize() -> Optional[ClusterSpec]:
     """Initialize ``jax.distributed`` when a cluster env is present.
 
@@ -67,6 +72,11 @@ def maybe_initialize() -> Optional[ClusterSpec]:
     """
     import jax
 
+    if _disarmed:
+        raise RuntimeError(
+            "horovod_tpu cannot re-initialize: a peer process died and "
+            "the jax.distributed cluster was abandoned. Restart the job "
+            "(e.g. relaunch via `python -m horovod_tpu.run`).")
     spec = cluster_spec_from_env()
     if spec is None:
         # The user may have initialized jax.distributed directly; honor it.
@@ -78,8 +88,55 @@ def maybe_initialize() -> Optional[ClusterSpec]:
                 process_id=jax.process_index())
         return None
     if spec.num_processes > 1 and not jax.distributed.is_initialized():
-        jax.distributed.initialize(
+        kwargs = dict(
             coordinator_address=spec.coordinator,
             num_processes=spec.num_processes,
-            process_id=spec.process_id)
+            process_id=spec.process_id,
+            heartbeat_timeout_seconds=int(
+                os.environ.get("HVD_TPU_HEARTBEAT_TIMEOUT", "100")),
+            shutdown_timeout_seconds=int(
+                os.environ.get("HVD_TPU_SHUTDOWN_TIMEOUT", "300")))
+        try:
+            jax.distributed.initialize(**kwargs)
+        except TypeError:
+            # Older jax without the timeout kwargs.
+            kwargs.pop("heartbeat_timeout_seconds")
+            kwargs.pop("shutdown_timeout_seconds")
+            jax.distributed.initialize(**kwargs)
     return spec
+
+
+def disarm_distributed_shutdown() -> None:
+    """Skip ``jax.distributed``'s exit-time shutdown barrier.
+
+    JAX registers an atexit hook (jax/_src/api.py ``clean_up``) that calls
+    ``jax.distributed.shutdown()``, which enters a coordination-service
+    barrier waiting for EVERY process.  Once we know a peer died without
+    reaching that barrier, it can only fail — after blocking the survivor
+    for ``heartbeat_timeout_seconds`` (100 s default) and then fatally
+    aborting the process (client.h LOG(FATAL)), which also discards
+    buffered output.  The reference's equivalent failure mode is an MPI
+    job hanging in MPI_Finalize until the scheduler kills it.
+
+    Dropping the client reference makes that atexit hook a no-op so the
+    survivor can exit promptly with its diagnosis.  The coordination
+    *service* (rank 0 hosts it) is left in place — its shutdown does not
+    block on peers.
+
+    After this, the process is expected to exit: the cluster is missing a
+    member and cannot be re-formed from within (``jax.distributed`` does
+    not support re-initialization), so ``maybe_initialize`` refuses with
+    a diagnosis instead of letting jax raise an opaque error.
+    """
+    global _disarmed
+    _disarmed = True
+    try:
+        from jax._src import distributed as _jd
+
+        state = _jd.global_state
+        if getattr(state, "preemption_sync_manager", None) is not None:
+            state.preemption_sync_manager.shutdown()
+            state.preemption_sync_manager = None
+        state.client = None  # leaked deliberately; the process is exiting
+    except Exception:  # noqa: BLE001 — best-effort across jax versions
+        pass

@@ -249,10 +249,29 @@ def shutdown() -> None:
         with _collective._drain_lock:
             if (_state.multiprocess and _state.transport is not None
                     and _state.process_index != 0):
+                # Drain responses the stopped background thread never got
+                # to — a dead-peer SHUTDOWN diagnosis may be queued, and
+                # executing it here still disarms jax's exit barrier
+                # (otherwise this rank would exit armed and block on the
+                # dead peer).
+                while True:
+                    resps = _state.transport.poll_responses()
+                    if resps is None:
+                        break
+                    for resp in resps:
+                        _collective._execute_response(
+                            resp, _collective._queue.take(resp.tensor_names))
                 try:
                     _state.transport.request_shutdown()
                 except OSError:
                     pass  # controller already gone
+            if (_state.multiprocess and _state.transport is not None
+                    and _state.process_index == 0
+                    and _state.transport.lost_ranks
+                    and not _state.peer_shutdown):
+                # A peer death detected after the last drain tick gets the
+                # same handling as the drain loop's lost_ranks branch.
+                _collective._handle_lost_ranks(_state, _state.transport)
             if not _state.peer_shutdown:
                 _collective._initiate_shutdown()
     with _state.lock:

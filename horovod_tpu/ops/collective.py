@@ -94,6 +94,29 @@ def _initiate_shutdown(message: str = SHUT_DOWN_ERROR_MESSAGE) -> None:
     _poison_pending(message)
 
 
+def _handle_lost_ranks(st, tp) -> None:
+    """Controller-side dead-peer handling: EOF without the exit handshake
+    = the process died.  It can never reach jax.distributed's exit
+    barrier; don't let that block (then abort) any survivor — the marked
+    diagnosis makes the workers disarm too.  Callers must hold
+    ``_drain_lock`` or have stopped the background drain first (same
+    contract as ``_initiate_shutdown``); called from the drain loop and
+    from ``hvd.shutdown()`` when the death lands after the last tick."""
+    from ..core import cluster as _cluster
+
+    _cluster.disarm_distributed_shutdown()
+    ranks = sorted(tp.lost_ranks)
+    pending = bool(_queue.pending_meta()) or bool(
+        st.coordinator.check_stalled(threshold=0.0))
+    detail = " while collectives were pending" if pending else ""
+    _initiate_shutdown(
+        f"Horovod has been shut down: rank(s) {ranks} "
+        f"{wire.DEAD_PEER_MARKER}{detail}.")
+    print(f"ERROR: worker rank(s) {ranks} {wire.DEAD_PEER_MARKER};"
+          f"{' pending collectives failed;' if pending else ''}"
+          f" shutting down.", file=sys.stderr)
+
+
 # Autogenerated op names (≙ torch/mpi_ops.cc:35-40 "prefix.noname.<n>").
 _name_lock = threading.Lock()
 _name_counters: Dict[str, int] = {}
@@ -469,6 +492,14 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
         # A rank initiated shutdown (or died): flush everything pending
         # with the shut-down error — carrying the initiator's diagnosis
         # when present — and refuse new work (operations.cc:1377-1403).
+        # A diagnosis naming a dead process means that process can never
+        # reach jax.distributed's exit barrier — every survivor (not just
+        # the controller) must skip it or block 300 s and abort.  Clean
+        # cooperative shutdowns carry no marker and keep the barrier.
+        if wire.DEAD_PEER_MARKER in (resp.error_message or ""):
+            from ..core.cluster import disarm_distributed_shutdown
+
+            disarm_distributed_shutdown()
         st.peer_shutdown = True
         _poison_pending(resp.error_message or SHUT_DOWN_ERROR_MESSAGE)
         return
@@ -702,19 +733,7 @@ def _drain() -> None:
                 # a message naming the rank (the reference can only hang
                 # here); otherwise it is an implicit shutdown.
                 if tp.lost_ranks and not st.peer_shutdown:
-                    ranks = sorted(tp.lost_ranks)
-                    pending = bool(_queue.pending_meta()) or bool(
-                        st.coordinator.check_stalled(threshold=0.0))
-                    if pending:
-                        _initiate_shutdown(
-                            f"Horovod has been shut down: rank(s) {ranks} "
-                            f"terminated unexpectedly while collectives "
-                            f"were pending.")
-                        print(f"ERROR: worker rank(s) {ranks} terminated "
-                              f"unexpectedly; pending collectives failed.",
-                              file=sys.stderr)
-                    else:
-                        _initiate_shutdown()
+                    _handle_lost_ranks(st, tp)
                 # Coordinator: poll, broadcast the fused responses to every
                 # worker, then execute locally in the same order
                 # (≙ MPI_Bcast of the response list, operations.cc:1290).

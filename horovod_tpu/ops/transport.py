@@ -20,6 +20,7 @@ Frame layout: ``<u32 length><u8 type><payload>`` (little-endian).
 
 from __future__ import annotations
 
+import atexit
 import os
 import queue
 import socket
@@ -30,7 +31,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from . import wire
-from .wire import Request, Response, ResponseType
+from .wire import DEAD_PEER_MARKER, Request, Response, ResponseType
 
 FRAME_HELLO = 0       # worker→controller: <i rank><H len><hostname>
 FRAME_REQUEST = 1     # worker→controller: packed Request
@@ -155,6 +156,20 @@ class ControllerTransport:
                                   daemon=True)
             th.start()
             self._threads.append(th)
+        # Mirror of the worker exit handshake: a controller whose
+        # interpreter exits without hvd.shutdown() still broadcasts a clean
+        # SHUTDOWN, so workers take the cooperative path (and keep jax's
+        # exit barrier, which a cleanly-exiting controller does reach).
+        atexit.register(self._atexit_handshake)
+
+    def _atexit_handshake(self) -> None:
+        if self._closing:
+            return
+        try:
+            self.broadcast_responses(
+                [Response(ResponseType.SHUTDOWN)])
+        except OSError:
+            pass
 
     def _serve(self, rank: int, conn: socket.socket) -> None:
         while True:
@@ -203,6 +218,7 @@ class ControllerTransport:
 
     def close(self) -> None:
         self._closing = True
+        atexit.unregister(self._atexit_handshake)
         with self._lock:
             conns = list(self._conns.values())
             self._conns.clear()
@@ -255,6 +271,24 @@ class WorkerTransport:
         self._rx = threading.Thread(target=self._recv_loop,
                                     name=f"hvd-worker-rx-{rank}", daemon=True)
         self._rx.start()
+        # Exit handshake (≙ the reference's DONE/shutdown flag on the last
+        # MPIRequestList, mpi_message.h:87-103): a worker whose interpreter
+        # exits without an explicit hvd.shutdown() still tells the
+        # controller it left *cleanly*.  An EOF without this frame is
+        # therefore always a crash.  Registered after jax.distributed
+        # initialize, so (atexit LIFO) it runs before jax's exit barrier.
+        atexit.register(self._atexit_handshake)
+
+    def _atexit_handshake(self) -> None:
+        # Sent even when a shutdown was already received (it's idempotent):
+        # skipping it would make this worker's EOF look like a crash to a
+        # controller whose own exit handshake fired first.
+        if self._closing:
+            return
+        try:
+            self.request_shutdown()
+        except OSError:
+            pass  # controller already gone
 
     def _recv_loop(self) -> None:
         while True:
@@ -268,11 +302,18 @@ class WorkerTransport:
                 # pending ops fail with a diagnosis instead of hanging
                 # (mirror of the controller's dead-worker detection).
                 if not (self.shutdown_received.is_set() or self._closing):
+                    from ..core.cluster import disarm_distributed_shutdown
+
+                    # EOF without a SHUTDOWN response (not even the
+                    # controller's exit handshake): the controller crashed
+                    # and can never reach jax.distributed's exit barrier;
+                    # don't block (then abort) on it.
+                    disarm_distributed_shutdown()
                     self._responses.put([Response(
                         ResponseType.SHUTDOWN,
                         error_message="Horovod has been shut down: the "
-                        "rank-0 controller's connection was lost (the "
-                        "process died?) while collectives were pending.")])
+                        f"rank-0 controller {DEAD_PEER_MARKER} while "
+                        "collectives were pending.")])
                 return
             if ftype == FRAME_RESPONSES:
                 resps = wire.unpack_response_list(payload)
@@ -301,6 +342,7 @@ class WorkerTransport:
 
     def close(self) -> None:
         self._closing = True
+        atexit.unregister(self._atexit_handshake)
         try:
             self._sock.close()
         except OSError:

@@ -113,6 +113,17 @@ class ResponseType(IntEnum):
 # Device id of a host-resident tensor (≙ CPU_DEVICE_ID, common.h:28).
 CPU_DEVICE_ID = -1
 
+# Phrase carried by every dead-peer SHUTDOWN diagnosis (a peer vanished
+# without its exit handshake).  Survivors that see it must skip
+# jax.distributed's exit barrier — the dead process can never join it —
+# via core.cluster.disarm_distributed_shutdown.  Defined here because the
+# producers live in three modules (ops/collective.py and core/state.py on
+# the controller side, ops/transport.py on the worker side).  Deliberate
+# tradeoff: this rides the existing error_message field rather than a new
+# wire flag, which would also touch the C++ twin (native/wire.cc) for one
+# bit; every producer MUST build its message from this constant.
+DEAD_PEER_MARKER = "terminated unexpectedly"
+
 
 @dataclass
 class Request:

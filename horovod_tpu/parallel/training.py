@@ -57,6 +57,50 @@ def replicate(tree, mesh=None):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
 
 
+def _is_cpu_mesh(mesh) -> bool:
+    try:
+        return mesh.devices.flat[0].platform == "cpu"
+    except Exception:  # noqa: BLE001 — any exotic mesh: don't throttle
+        return False
+
+
+def _throttle_on_cpu(step_fn, mesh):
+    """Bound async dispatch to one in-flight invocation on CPU meshes.
+
+    The host-platform backend (virtual devices for testing) runs every
+    replica's collective on one shared thread pool; with unbounded async
+    dispatch a long training loop stacks dozens of executions and the
+    cross-replica rendezvous starves past XLA's 40 s abort
+    (rendezvous.cc "Expected N threads to join").  Real TPU meshes are
+    untouched — their pipelining is the performance model.  Blocking on
+    the *previous* call's outputs keeps one step in flight, so even on
+    CPU the host never idles while a step runs.
+    """
+    if not _is_cpu_mesh(mesh):
+        return step_fn
+    return _ThrottledStep(step_fn)
+
+
+class _ThrottledStep:
+    """Callable wrapper keeping one invocation in flight (see
+    :func:`_throttle_on_cpu`); delegates the rest of the jit API
+    (``lower``, ``trace``, ``clear_cache``, ...) to the wrapped step."""
+
+    def __init__(self, step_fn):
+        self._step_fn = step_fn
+        self._prev = None
+
+    def __call__(self, *args, **kw):
+        if self._prev is not None:
+            jax.block_until_ready(self._prev)
+        out = self._step_fn(*args, **kw)
+        self._prev = out
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._step_fn, name)
+
+
 def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
                has_aux, donate, has_state):
     """Shared builder behind :func:`make_train_step` and
@@ -119,7 +163,8 @@ def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
             return params, opt_state, loss
 
         donate_argnums = (0, 1) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    return _throttle_on_cpu(jax.jit(step, donate_argnums=donate_argnums),
+                            mesh)
 
 
 def make_train_step(
@@ -198,7 +243,8 @@ def make_parallel_train_step(loss_fn: Callable[..., Any], optimizer,
         return params, opt_state, loss
 
     donate_argnums = (0, 1) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    return _throttle_on_cpu(jax.jit(step, donate_argnums=donate_argnums),
+                            mesh)
 
 
 def shard_parallel_batch(batch, mesh, batch_spec):
@@ -225,4 +271,4 @@ def make_eval_step(metric_fn: Callable[..., Any], mesh=None):
     sharded = jax.shard_map(
         per_replica, mesh=mesh, in_specs=(P(), P(REPLICA_AXIS)),
         out_specs=P(), check_vma=False)
-    return jax.jit(sharded)
+    return _throttle_on_cpu(jax.jit(sharded), mesh)

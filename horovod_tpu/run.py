@@ -68,7 +68,10 @@ def main(argv=None) -> int:
             env["JAX_PLATFORMS"] = args.platform
             if args.platform == "cpu":
                 env.pop("PALLAS_AXON_POOL_IPS", None)
-        p = subprocess.Popen([sys.executable] + args.command, env=env,
+        # -u: a worker that dies abruptly (or is torn down by the JAX
+        # coordination service) must not lose block-buffered output —
+        # mpirun's stdout forwarding has the same property.
+        p = subprocess.Popen([sys.executable, "-u"] + args.command, env=env,
                              stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT)
         procs.append(p)

@@ -118,7 +118,9 @@ def scenario_dead_worker(hvd):
     from horovod_tpu import HorovodError
 
     rank = hvd.rank()
-    if rank == 0:
+    # The last rank dies; EVERY survivor (controller and plain workers
+    # alike) must get a diagnosed failure and exit promptly.
+    if rank < hvd.size() - 1:
         h = hvd.allreduce_async(jnp.ones((2,)), name="orphaned.op",
                                 average=False)
         try:
@@ -131,6 +133,31 @@ def scenario_dead_worker(hvd):
     else:
         time.sleep(1.0)
         os._exit(0)  # die without any shutdown handshake
+
+
+def scenario_clean_exit(hvd):
+    """Rank 1 finishes WITHOUT calling hvd.shutdown(): the transport's
+    atexit handshake must turn the interpreter exit into a cooperative
+    shutdown — rank 0 gets the plain shut-down error (no crash
+    diagnosis), and both processes still exit rc=0 through
+    jax.distributed's exit barrier."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import HorovodError
+
+    rank = hvd.rank()
+    out = hvd.allreduce(jnp.ones((2,)), name="warm.op", average=False)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    if rank == 1:
+        main.skip_shutdown = True
+        print("CLEANEXIT_OK rank=1")
+        return  # interpreter exit fires the handshake
+    try:
+        hvd.allreduce(jnp.ones((2,)), name="late.op", average=False)
+        raise AssertionError("expected the shut-down error")
+    except HorovodError as e:
+        assert "terminated unexpectedly" not in str(e), str(e)
+        print("CLEANEXIT_OK rank=0")
 
 
 def scenario_checkpoint(hvd):
@@ -167,7 +194,8 @@ def main():
     try:
         globals()[f"scenario_{scenario}"](hvd)
     finally:
-        hvd.shutdown()
+        if not getattr(main, "skip_shutdown", False):
+            hvd.shutdown()
 
 
 if __name__ == "__main__":

@@ -17,7 +17,7 @@ WORKER = os.path.join(REPO, "tests", "mp_worker.py")
 
 
 def _launch(scenario: str, extra_env=None, timeout: float = 300.0,
-            expect_rc0: bool = True):
+            expect_rc0: bool = True, np_: int = 2):
     env = dict(os.environ)
     # One CPU device per process (the launcher's conftest-style 8-device
     # override would blur the process==replica mapping this test is about).
@@ -26,7 +26,7 @@ def _launch(scenario: str, extra_env=None, timeout: float = 300.0,
         if not f.startswith("--xla_force_host_platform_device_count"))
     env.update(extra_env or {})
     proc = subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
          "--platform", "cpu", WORKER, scenario],
         env=env, cwd=REPO, capture_output=True, timeout=timeout)
     out = proc.stdout.decode()
@@ -68,12 +68,36 @@ def test_two_process_stall_warning_names_missing_rank():
 
 @pytest.mark.slow
 def test_dead_worker_fails_pending_ops_with_rank():
-    # A worker dying mid-job makes the launch exit nonzero (jax's
-    # coordination service aborts the survivors at teardown) — correct
+    # A worker dying mid-job still exits the launch nonzero (the jax
+    # coordination service reports the dead task at teardown) — correct
     # for a distributed job; the assertions are about the detection.
-    out = _launch("dead_worker", expect_rc0=False)
+    # The survivor must exit promptly with its diagnosis rather than
+    # blocking in jax's exit barrier (disarm_distributed_shutdown).
+    out = _launch("dead_worker", expect_rc0=False, timeout=120.0)
     assert "DEADWORKER_OK rank=0" in out
     assert "terminated unexpectedly" in out  # controller's stderr report
+
+
+@pytest.mark.slow
+def test_dead_worker_all_survivors_diagnose_and_exit():
+    # np=3, last rank dies: BOTH survivors — the rank-0 controller and a
+    # plain worker — must fail pending ops with the diagnosis and exit
+    # promptly (neither may block in jax.distributed's exit barrier,
+    # which the dead rank can never reach).
+    out = _launch("dead_worker", expect_rc0=False, timeout=120.0, np_=3)
+    assert "DEADWORKER_OK rank=0" in out
+    assert "DEADWORKER_OK rank=1" in out
+
+
+@pytest.mark.slow
+def test_clean_exit_without_shutdown_is_cooperative():
+    # A worker that simply returns (no hvd.shutdown()) must NOT be
+    # diagnosed as crashed: the exit handshake makes it cooperative, both
+    # processes keep jax's exit barrier, and the launch exits rc=0.
+    out = _launch("clean_exit", timeout=120.0)
+    assert "CLEANEXIT_OK rank=0" in out
+    assert "CLEANEXIT_OK rank=1" in out
+    assert "terminated unexpectedly" not in out
 
 
 @pytest.mark.slow
