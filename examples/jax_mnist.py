@@ -10,6 +10,7 @@ train, checkpoint on rank 0.  Same flow here, with the step compiled as one
 SPMD program.
 """
 
+import os
 import sys
 
 import jax.numpy as jnp
@@ -46,11 +47,15 @@ def main():
     opt_state = opt.init(params)
     step = make_train_step(loss_fn, opt)
 
-    images, labels = synthetic_mnist(2048)
+    # Overridable so CI can shrink the run (≙ the reference patching its
+    # examples smaller with sed, .travis.yml:105-109).
+    n_data = int(os.environ.get("HVD_TPU_EXAMPLE_DATA", "2048"))
+    epochs = int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "2"))
+    images, labels = synthetic_mnist(n_data)
     global_batch = 16 * hvd.size()
     steps_per_epoch = len(images) // global_batch
 
-    for epoch in range(2):
+    for epoch in range(epochs):
         perm = np.random.RandomState(epoch).permutation(len(images))
         for s in range(steps_per_epoch):
             idx = perm[s * global_batch:(s + 1) * global_batch]

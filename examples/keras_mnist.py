@@ -50,7 +50,8 @@ def main():
         hvd.callbacks.MetricAverageCallback(),
         hvd.callbacks.LearningRateWarmupCallback(warmup_epochs=2),
     ]
-    hist = model.fit(x, y, batch_size=128, epochs=4, verbose=0,
+    epochs = int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "4"))
+    hist = model.fit(x, y, batch_size=128, epochs=epochs, verbose=0,
                      callbacks=callbacks)
     for e, (loss, acc) in enumerate(zip(hist.history["loss"],
                                         hist.history["accuracy"])):

@@ -6,6 +6,7 @@ Usage:
       python examples/mnist_callbacks.py
 """
 
+import os
 import sys
 
 import jax.numpy as jnp
@@ -52,7 +53,9 @@ def main():
         idx = rng.randint(0, len(images), size=global_batch)
         return (jnp.asarray(images[idx]), jnp.asarray(labels[idx]))
 
-    history = trainer.fit(batches, epochs=6, steps_per_epoch=steps_per_epoch)
+    epochs = int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "6"))
+    history = trainer.fit(batches, epochs=epochs,
+                          steps_per_epoch=steps_per_epoch)
     for e, logs in enumerate(history):
         print(f"epoch {e}: {logs}")
     hvd.shutdown()

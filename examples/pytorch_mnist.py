@@ -7,6 +7,7 @@ Usage (8 virtual replicas on CPU):
       python examples/pytorch_mnist.py
 """
 
+import os
 import sys
 
 sys.path.insert(0, ".")
@@ -48,7 +49,8 @@ def main():
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
 
     first_loss = None
-    for epoch in range(3):
+    epochs = int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "3"))
+    for epoch in range(epochs):
         losses = []
         for i in range(0, len(x), 128):
             xb, yb = x[i:i + 128], y[i:i + 128]

@@ -1,0 +1,70 @@
+"""Examples as integration tests (≙ the reference's CI patching the MNIST
+examples smaller with sed and running them end-to-end under mpirun,
+.travis.yml:105-123).  Each example runs as a real subprocess on the
+8-virtual-replica CPU platform with env knobs shrinking the workload.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name: str, extra_env=None, args=(), timeout: float = 420.0):
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name), *args],
+        env=env, cwd=REPO, capture_output=True, timeout=timeout)
+    out = proc.stdout.decode() + proc.stderr.decode()
+    assert proc.returncode == 0, f"{name} failed:\n{out}"
+    return out
+
+
+@pytest.mark.slow
+def test_jax_mnist_example():
+    out = _run_example("jax_mnist.py",
+                       {"HVD_TPU_EXAMPLE_EPOCHS": "1",
+                        "HVD_TPU_EXAMPLE_DATA": "512"})
+    assert "replicas=8" in out
+    assert "train-set accuracy:" in out
+    assert "checkpoint saved" in out
+
+
+@pytest.mark.slow
+def test_word2vec_example():
+    out = _run_example("word2vec.py", {"HVD_TPU_EXAMPLE_STEPS": "5"})
+    assert "step 0: loss=" in out
+
+
+@pytest.mark.slow
+def test_mnist_callbacks_example():
+    # 3 epochs: covers the 2-epoch warmup ramp plus one epoch at full LR.
+    out = _run_example("mnist_callbacks.py", {"HVD_TPU_EXAMPLE_EPOCHS": "3"})
+    assert "epoch 0:" in out and "epoch 2:" in out
+
+
+@pytest.mark.slow
+def test_pytorch_mnist_example():
+    out = _run_example("pytorch_mnist.py", {"HVD_TPU_EXAMPLE_EPOCHS": "2"})
+    assert "pytorch_mnist: OK" in out
+
+
+@pytest.mark.slow
+def test_keras_mnist_example():
+    out = _run_example("keras_mnist.py", {"HVD_TPU_EXAMPLE_EPOCHS": "2"})
+    assert "keras_mnist: OK" in out
+
+
+@pytest.mark.slow
+def test_resnet50_synthetic_example():
+    # Start cold: the example resumes from its fixed checkpoint path.
+    ckpt = "/tmp/horovod_tpu_resnet50/ckpt.msgpack"
+    if os.path.exists(ckpt):
+        os.remove(ckpt)
+    out = _run_example("resnet50_synthetic.py", args=("--epochs", "1"))
+    assert "epoch 0:" in out
+    assert "checkpoint saved" in out
