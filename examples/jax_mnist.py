@@ -48,11 +48,13 @@ def main():
     step = make_train_step(loss_fn, opt)
 
     # Overridable so CI can shrink the run (≙ the reference patching its
-    # examples smaller with sed, .travis.yml:105-109).
-    n_data = int(os.environ.get("HVD_TPU_EXAMPLE_DATA", "2048"))
-    epochs = int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "2"))
-    images, labels = synthetic_mnist(n_data)
+    # examples smaller with sed, .travis.yml:105-109).  Clamped so at
+    # least one full global batch and one epoch always run.
     global_batch = 16 * hvd.size()
+    n_data = max(int(os.environ.get("HVD_TPU_EXAMPLE_DATA", "2048")),
+                 global_batch)
+    epochs = max(1, int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "2")))
+    images, labels = synthetic_mnist(n_data)
     steps_per_epoch = len(images) // global_batch
 
     for epoch in range(epochs):

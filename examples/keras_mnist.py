@@ -50,7 +50,7 @@ def main():
         hvd.callbacks.MetricAverageCallback(),
         hvd.callbacks.LearningRateWarmupCallback(warmup_epochs=2),
     ]
-    epochs = int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "4"))
+    epochs = max(1, int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "4")))
     hist = model.fit(x, y, batch_size=128, epochs=epochs, verbose=0,
                      callbacks=callbacks)
     for e, (loss, acc) in enumerate(zip(hist.history["loss"],
@@ -62,7 +62,8 @@ def main():
     if hvd.rank() == 0:
         model.save("/tmp/keras_mnist_hvd.keras")
         print("saved /tmp/keras_mnist_hvd.keras")
-    assert hist.history["loss"][-1] < hist.history["loss"][0]
+    if epochs > 1:  # single-epoch CI runs have nothing to compare
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
     hvd.shutdown()
     print("keras_mnist: OK")
 

@@ -53,7 +53,7 @@ def main():
         idx = rng.randint(0, len(images), size=global_batch)
         return (jnp.asarray(images[idx]), jnp.asarray(labels[idx]))
 
-    epochs = int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "6"))
+    epochs = max(1, int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "6")))
     history = trainer.fit(batches, epochs=epochs,
                           steps_per_epoch=steps_per_epoch)
     for e, logs in enumerate(history):

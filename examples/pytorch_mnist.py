@@ -49,7 +49,7 @@ def main():
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
 
     first_loss = None
-    epochs = int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "3"))
+    epochs = max(1, int(os.environ.get("HVD_TPU_EXAMPLE_EPOCHS", "3")))
     for epoch in range(epochs):
         losses = []
         for i in range(0, len(x), 128):
@@ -68,7 +68,8 @@ def main():
             first_loss = avg
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss={avg:.4f}")
-    assert avg < first_loss
+    if epochs > 1:  # single-epoch CI runs have nothing to compare
+        assert avg < first_loss
     hvd.shutdown()
     print("pytorch_mnist: OK")
 

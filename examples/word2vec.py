@@ -37,7 +37,7 @@ def main():
             return W.nce_loss(p, centers, targets, negs)
         return jax.value_and_grad(loss, argnums=(0, 1, 2))(emb, nce_w, nce_b)
 
-    n_steps = int(os.environ.get("HVD_TPU_EXAMPLE_STEPS", "100"))
+    n_steps = max(1, int(os.environ.get("HVD_TPU_EXAMPLE_STEPS", "100")))
     for step in range(n_steps):
         centers, targets = W.skipgram_batch(rng, corpus, batch_size=128)
         negs = rng.randint(0, vocab, size=64).astype("int32")

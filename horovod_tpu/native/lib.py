@@ -36,14 +36,34 @@ _lib = None
 NATIVE = False
 
 
+def _needs_build() -> bool:
+    """True when the .so is absent or older than any source file."""
+    if not os.path.exists(_SO_PATH):
+        return True
+    so_mtime = os.path.getmtime(_SO_PATH)
+    for name in os.listdir(_DIR):
+        if name.endswith((".cc", ".h")) or name == "Makefile":
+            if os.path.getmtime(os.path.join(_DIR, name)) > so_mtime:
+                return True
+    return False
+
+
 def _try_build() -> bool:
+    """Build under an exclusive file lock so concurrent ranks importing
+    after a source edit serialize; the Makefile links to a temp name and
+    renames, so a parallel ``CDLL`` never maps a half-written library."""
     try:
-        subprocess.run(
-            ["make", "-s", "-C", _DIR],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
+        import fcntl
+
+        with open(os.path.join(_DIR, ".build.lock"), "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if _needs_build():  # re-check: another rank may have built
+                subprocess.run(
+                    ["make", "-s", "-C", _DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
         return os.path.exists(_SO_PATH)
     except Exception:
         return False
@@ -53,9 +73,13 @@ def _load() -> None:
     global _lib, NATIVE
     if os.environ.get("HVD_TPU_DISABLE_NATIVE"):
         return
+    # Rebuild when a .cc/.h changed — a silently stale binary would
+    # desync the native coordinator from its Python twin.  Fresh .so:
+    # no subprocess, just mtime stats.
+    if _needs_build() and os.path.exists(os.path.join(_DIR, "Makefile")):
+        _try_build()
     if not os.path.exists(_SO_PATH):
-        if not os.path.exists(os.path.join(_DIR, "Makefile")) or not _try_build():
-            return
+        return  # no toolchain and no prebuilt library: Python fallback
     try:
         _lib = ctypes.CDLL(_SO_PATH)
     except OSError:

@@ -34,6 +34,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running test (multi-process spawns, "
         "interpret-mode pallas backward passes)")
+    # The int64 wire-dtype tests intentionally run without jax_enable_x64
+    # (values stay in int32 range); jax's truncation notice is expected.
+    config.addinivalue_line(
+        "filterwarnings",
+        "ignore:Explicitly requested dtype.*int64.*:UserWarning")
 
 
 @pytest.fixture()
