@@ -1,0 +1,121 @@
+"""Transformer language-model training — the beyond-parity stack on one
+model: multi-axis mesh (`core/topology.make_mesh`), Pallas flash
+attention (`ops/flash_attention.py`), and `make_parallel_train_step`.
+The reference has no transformer workload (it predates them); this is
+the workload behind docs/benchmarks.md's tokens/sec table.
+
+Usage:
+  # tiny LM on 8 virtual CPU replicas (dp4 x tp2):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/transformer_lm.py
+
+  # single real TPU chip, GPT-2-small shape, throughput JSON:
+  python examples/transformer_lm.py --bench
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+sys.path.insert(0, ".")
+
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.core.topology import make_mesh  # noqa: E402
+from horovod_tpu.models.transformer import (ParallelAxes,  # noqa: E402
+                                            TransformerConfig,
+                                            init_transformer, make_loss_fn,
+                                            synthetic_lm_batch)
+from horovod_tpu.parallel.training import (  # noqa: E402
+    make_parallel_train_step, shard_parallel_batch)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bench", action="store_true",
+                    help="GPT-2-small shape on the local device(s); print "
+                         "one tokens/sec JSON line")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args()
+
+    hvd.init()
+    n_dev = len(jax.devices())
+
+    if args.bench:
+        cfg = TransformerConfig(vocab_size=32768, d_model=768, n_heads=12,
+                                n_layers=12, d_ff=3072,
+                                max_seq_len=args.seq or 1024,
+                                dtype=jnp.bfloat16, block_q=256, block_k=256)
+        batch, seq, steps = args.batch or 8, args.seq or 1024, \
+            args.steps or 20
+        mesh = make_mesh(data=n_dev)
+        ax = ParallelAxes(data="data")
+    else:
+        cfg = TransformerConfig(vocab_size=512, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=128,
+                                max_seq_len=max(args.seq or 128, 128),
+                                block_q=32, block_k=32)
+        batch, seq = args.batch or 2 * n_dev, args.seq or 64
+        steps = args.steps or int(
+            os.environ.get("HVD_TPU_EXAMPLE_STEPS", "30"))
+        # Model-parallel degree 2 when the device count allows — the
+        # same program shape the multi-chip dryrun validates.
+        tp = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+        mesh = make_mesh(data=n_dev // tp, model=tp)
+        ax = ParallelAxes(data="data", model="model" if tp > 1 else None)
+
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    loss_fn = make_loss_fn(cfg, ax, mesh_axes=mesh.axis_names)
+    opt = optax.adamw(3e-4)
+    step = make_parallel_train_step(loss_fn, opt, mesh, P("data", None),
+                                    donate=False)
+    tokens, targets = synthetic_lm_batch(jax.random.PRNGKey(1), batch, seq,
+                                         cfg.vocab_size)
+    data = shard_parallel_batch((tokens, targets), mesh, P("data", None))
+    opt_state = opt.init(params)
+
+    params, opt_state, loss = step(params, opt_state, data)
+    first = float(loss)  # also the compile barrier
+
+    if args.bench:
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, data)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, data)
+        float(loss)
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": "transformer_lm_tokens_per_sec",
+            "value": round(batch * seq * steps / dt, 1),
+            "unit": "tokens/sec",
+            "params_millions": round(n_params / 1e6, 1),
+            "batch": batch, "seq": seq,
+            "step_ms": round(dt / steps * 1000, 1),
+        }))
+    else:
+        for s in range(1, steps):
+            params, opt_state, loss = step(params, opt_state, data)
+            if s % 10 == 0:
+                print(f"step {s}: loss={float(loss):.4f}")
+        final = float(loss)
+        print(f"loss {first:.4f} -> {final:.4f} "
+              f"({n_params/1e6:.1f}M params, mesh={dict(zip(mesh.axis_names, mesh.devices.shape))})")
+        assert final < first, "loss did not improve"
+        print("transformer_lm: OK")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,14 @@ def test_keras_mnist_example():
 
 
 @pytest.mark.slow
+def test_transformer_lm_example():
+    # dp4 x tp2 over the 8 virtual devices; loss must improve.
+    out = _run_example("transformer_lm.py",
+                       {"HVD_TPU_EXAMPLE_STEPS": "15"})
+    assert "transformer_lm: OK" in out
+
+
+@pytest.mark.slow
 def test_resnet50_synthetic_example():
     # Start cold: the example resumes from its fixed checkpoint path.
     ckpt = "/tmp/horovod_tpu_resnet50/ckpt.msgpack"
