@@ -135,6 +135,33 @@ def scenario_dead_worker(hvd):
         os._exit(0)  # die without any shutdown handshake
 
 
+def scenario_dead_controller(hvd):
+    """Rank 0 (the controller) dies without any handshake.  Rank 0 also
+    hosts the jax coordination service, so jax's client usually
+    fatal-kills the worker the instant the service socket closes; when
+    our transport's EOF detection wins that race instead, the pending op
+    fails with the controller-death diagnosis.  Either way the worker
+    must terminate promptly — the launch-level assertion."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import HorovodError
+
+    rank = hvd.rank()
+    if rank == 0:
+        time.sleep(1.0)
+        os._exit(0)  # controller dies without any shutdown handshake
+    else:
+        h = hvd.allreduce_async(jnp.ones((2,)), name="orphaned.op",
+                                average=False)
+        try:
+            hvd.synchronize(h)
+        except HorovodError as e:
+            assert "controller terminated unexpectedly" in str(e), str(e)
+            print(f"DEADCTRL_OK rank={rank}")
+            return
+        raise AssertionError("dead controller was not detected")
+
+
 def scenario_clean_exit(hvd):
     """Rank 1 finishes WITHOUT calling hvd.shutdown(): the transport's
     atexit handshake must turn the interpreter exit into a cooperative

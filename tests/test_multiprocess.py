@@ -90,6 +90,22 @@ def test_dead_worker_all_survivors_diagnose_and_exit():
 
 
 @pytest.mark.slow
+def test_dead_controller_terminates_workers_promptly():
+    # Rank 0 dies — taking the jax coordination service with it. The
+    # worker must terminate within seconds, either by jax's client
+    # noticing the dead service (the usual winner of the race) or by
+    # our transport's controller-death diagnosis. A hang here would
+    # block until the 120 s timeout and fail the test.
+    import time as _time
+
+    t0 = _time.monotonic()
+    out = _launch("dead_controller", expect_rc0=False, timeout=120.0)
+    assert _time.monotonic() - t0 < 90.0
+    assert ("DEADCTRL_OK rank=1" in out
+            or "JAX distributed service detected fatal errors" in out), out
+
+
+@pytest.mark.slow
 def test_clean_exit_without_shutdown_is_cooperative():
     # A worker that simply returns (no hvd.shutdown()) must NOT be
     # diagnosed as crashed: the exit handshake makes it cooperative, both
