@@ -21,6 +21,7 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import state as _state
@@ -55,6 +56,34 @@ def shard_batch(batch, mesh=None):
 def replicate(tree, mesh=None):
     sh = replicated_sharding(mesh)
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+
+def shard_local_batch(local_batch, mesh=None):
+    """Assemble the global sharded batch from each process's LOCAL rows.
+
+    The reference's input model: every rank loads only its own slice of
+    the data (DistributedSampler / ``dataset.shard``, reference
+    examples/pytorch_mnist.py:48-51) — no process ever materializes the
+    global batch.  Each process passes its local leading-axis rows here;
+    the processes' shards concatenate process-major into the global
+    batch.  Complements :func:`shard_batch`, which expects the full
+    global batch on every host (fine single-process; wasteful beyond).
+
+    Every process MUST pass the same number of rows (the global leading
+    axis is ``local_rows × process_count`` — drop or pad the dataset
+    tail, as DistributedSampler does); the global shape is passed
+    explicitly so a disagreement fails loudly instead of assembling
+    inconsistent global arrays.
+    """
+    sh = batch_sharding(mesh)
+    n_proc = _state.process_count()
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            sh, x, global_shape=(x.shape[0] * n_proc,) + x.shape[1:])
+
+    return jax.tree_util.tree_map(put, local_batch)
 
 
 def _is_cpu_mesh(mesh) -> bool:

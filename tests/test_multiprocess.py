@@ -67,6 +67,16 @@ def test_two_process_stall_warning_names_missing_rank():
 
 
 @pytest.mark.slow
+def test_two_process_spmd_training_step():
+    # The static fast path (make_train_step) across real processes:
+    # identical loss on every rank, and the per-process local-shard
+    # input model (shard_local_batch) matches the full-global-array one.
+    out = _launch("spmd_train")
+    assert "SPMD_OK rank=0" in out
+    assert "SPMD_OK rank=1" in out
+
+
+@pytest.mark.slow
 def test_dead_worker_fails_pending_ops_with_rank():
     # A worker dying mid-job still exits the launch nonzero (the jax
     # coordination service reports the dead task at teardown) — correct
