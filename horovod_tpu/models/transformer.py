@@ -58,6 +58,17 @@ class TransformerConfig:
     # Attention kernel blocks (MXU-aligned on TPU).
     block_q: int = 128
     block_k: int = 128
+    # Rematerialize each layer in the backward pass (jax.checkpoint):
+    # activation memory drops from O(n_layers) to O(1) layers at ~1/3
+    # more FLOPs — the standard trade for long sequences / deep stacks.
+    remat: bool = False
+    # Chunked cross-entropy: compute the loss over sequence chunks of
+    # this many positions, rematerializing each chunk's logits in the
+    # backward pass.  The [batch, seq, vocab] float32 logits tensor —
+    # the dominant long-context allocation (e.g. 8.6 GB at batch 8,
+    # seq 8192, vocab 32768) — never materializes; peak extra memory is
+    # one chunk's logits.  0 = off (single full-logits matmul).
+    loss_chunk: int = 0
 
 
 @dataclass(frozen=True)
@@ -204,6 +215,15 @@ def _layer(x, lp, cfg, ax, aux_acc):
     return _ffn_block(x, lp, cfg, ax, aux_acc)
 
 
+# Remat variant: recompute the layer's activations in the backward pass
+# instead of storing them (cfg/ax are static trace-time configuration).
+_layer_remat = jax.checkpoint(_layer, static_argnums=(2, 3))
+
+
+def _layer_fn(cfg):
+    return _layer_remat if cfg.remat else _layer
+
+
 def _index_layer(layers: dict, i):
     return jax.tree_util.tree_map(lambda leaf: leaf[i], layers)
 
@@ -215,12 +235,15 @@ def _slice_layers(layers: dict, start, count: int):
 
 
 def forward(params: dict, tokens, cfg: TransformerConfig,
-            ax: ParallelAxes = ParallelAxes()):
+            ax: ParallelAxes = ParallelAxes(), return_hidden: bool = False):
     """Logits for local token shard; call inside shard_map.
 
     ``tokens``: ``[batch_local, seq_local]`` int32 — batch sharded over
     ``ax.data``, sequence sharded (shard-major) over ``ax.seq``.
-    Returns ``(logits [b, s_loc, vocab], aux_loss scalar)``.
+    Returns ``(logits [b, s_loc, vocab], aux_loss scalar)`` — or, with
+    ``return_hidden``, the final post-LN hidden states
+    ``[b, s_loc, d_model]`` instead of logits (for chunked-loss callers
+    that never materialize the full logits tensor).
     """
     b, s_loc = tokens.shape
     seq_off = 0
@@ -257,8 +280,10 @@ def forward(params: dict, tokens, cfg: TransformerConfig,
 
         def stage_fn(stage_params, x_mb):
             for i in range(per_stage):
-                x_mb, _ = _layer(x_mb, _index_layer(stage_params, i), cfg,
-                                 ax, jnp.zeros((), jnp.float32))
+                x_mb, _ = _layer_fn(cfg)(x_mb,
+                                         _index_layer(stage_params, i),
+                                         cfg, ax,
+                                         jnp.zeros((), jnp.float32))
             return x_mb
 
         x = gpipe(stage_fn, mine, x,
@@ -266,10 +291,12 @@ def forward(params: dict, tokens, cfg: TransformerConfig,
                   axis_name=ax.pipe)
     else:
         for i in range(cfg.n_layers):
-            x, aux = _layer(x, _index_layer(params["layers"], i), cfg, ax,
-                            aux)
+            x, aux = _layer_fn(cfg)(x, _index_layer(params["layers"], i),
+                                    cfg, ax, aux)
 
     x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    if return_hidden:
+        return x, aux
     logits = jnp.dot(x, params["unembed"],
                      preferred_element_type=jnp.float32)
     return logits, aux
@@ -291,13 +318,44 @@ def make_loss_fn(cfg: TransformerConfig, ax: ParallelAxes = ParallelAxes(),
             a for a in (ax.data, ax.model, ax.seq, ax.pipe, ax.expert)
             if a is not None))
 
-    def loss_fn(params, batch):
-        tokens, targets = batch
+    def dense_ce(params, tokens, targets):
         logits, aux = forward(params, tokens, cfg, ax)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None],
                                    axis=-1)[..., 0]
-        loss = jnp.mean(nll) + aux
+        return jnp.mean(nll) + aux
+
+    def chunked_ce(params, tokens, targets):
+        x, aux = forward(params, tokens, cfg, ax, return_hidden=True)
+        b, s_loc, d = x.shape
+        chunk = min(cfg.loss_chunk, s_loc)
+        if s_loc % chunk != 0:
+            raise ValueError(
+                f"local sequence length {s_loc} not divisible by "
+                f"loss_chunk {chunk}")
+        n = s_loc // chunk
+        xs = x.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
+        ts = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+
+        @jax.checkpoint
+        def chunk_nll(xc, tc):
+            logits = jnp.dot(xc, params["unembed"],
+                             preferred_element_type=jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return jnp.sum(
+                -jnp.take_along_axis(logp, tc[..., None], axis=-1))
+
+        def body(total, xt):
+            return total + chunk_nll(*xt), None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                (xs, ts))
+        return total / (b * s_loc) + aux
+
+    def loss_fn(params, batch):
+        tokens, targets = batch
+        ce = chunked_ce if cfg.loss_chunk > 0 else dense_ce
+        loss = ce(params, tokens, targets)
         return jax.lax.pmean(loss, axes)
 
     return loss_fn

@@ -118,15 +118,24 @@ def _apply_mask(s, *, q_start, k_start, kv_actual, kv_padded, causal,
 # Forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
-                causal: bool, block_k: int, kv_seq_len: int,
-                kv_actual: int, q_block_offset: int):
-    """One (batch*head, q_block) grid cell: online-softmax over K blocks.
+def _resident_max_seq() -> int:
+    """Sequences up to this length use the "resident" kernels (whole K/V
+    — or whole Q on the dKdV pass — held in VMEM, blocks walked by an
+    in-kernel loop): fewer grid cells, measurably faster at short seq.
+    Beyond it, the streaming kernels bound VMEM at O(block) — the
+    resident layout's O(seq) operand blows the ~16 MB VMEM around
+    seq 8K.  Read at TRACE time: changing the env after a function was
+    jit-compiled does not re-route its cached executable; tests force a
+    path by setting the env before tracing."""
+    return int(os.environ.get("HVD_TPU_FLASH_RESIDENT_SEQ", "4096"))
 
-    ``q_block_offset`` shifts the causal comparison for ring attention,
-    where the local q shard's global position differs from its local index.
-    ``kv_actual`` is the unpadded key count (keys past it are masked).
-    """
+
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                         sm_scale: float, causal: bool, block_k: int,
+                         kv_seq_len: int, kv_actual: int,
+                         q_block_offset: int):
+    """One (batch*head, q_block) grid cell: online-softmax over K blocks
+    held resident in VMEM."""
     block_q = q_ref.shape[0]
     head_dim = q_ref.shape[1]
     q_idx = pl.program_id(1)
@@ -156,7 +165,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
 
     if causal:
         # Blocks entirely in the future contribute nothing — skip them.
-        # (Static bound; the loop extent depends only on the grid cell.)
         hi = jnp.minimum(
             num_k_blocks,
             pl.cdiv((q_idx + 1) * block_q + q_block_offset, block_k))
@@ -164,15 +172,78 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
         hi = num_k_blocks
     m, l, acc = jax.lax.fori_loop(0, hi, body,
                                   (m_init, l_init, acc_init))
-    # Rows with no visible keys: either no block executed (l == 0) or every
-    # entry carried the mask value (m stayed at the mask floor).  Emit
-    # zeros with lse = -inf rather than dividing by zero / averaging junk.
     no_valid = jnp.logical_or(l == 0.0, m <= DEFAULT_MASK_VALUE * 0.5)
     l_safe = jnp.where(no_valid, 1.0, l)
     o_ref[:, :] = jnp.where(no_valid, 0.0,
                             acc / l_safe).astype(o_ref.dtype)
     lse = jnp.where(no_valid, -jnp.inf, m + jnp.log(l_safe))
     lse_ref[:, :] = lse.astype(jnp.float32)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_acc, l_acc, acc,
+                *, sm_scale: float, causal: bool, kv_actual: int,
+                kv_padded: int, q_block_offset: int):
+    """Grid cell (batch*head, q_block, k_block): one K block of the
+    online softmax, state carried in VMEM scratch across the
+    (sequential, innermost) k dimension.  Streaming K/V through the grid
+    keeps VMEM O(block) instead of O(seq) — see the backward kernels.
+
+    ``q_block_offset`` shifts the causal comparison for ring attention,
+    where the local q shard's global position differs from its local index.
+    ``kv_actual`` is the unpadded key count (keys past it are masked).
+    """
+    block_q = q_ref.shape[0]
+    block_k = k_ref.shape[0]
+    q_idx = pl.program_id(1)
+    k_idx = pl.program_id(2)
+    num_k_blocks = pl.num_programs(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        m_acc[:, :] = jnp.full_like(m_acc, -jnp.inf)
+        l_acc[:, :] = jnp.zeros_like(l_acc)
+        acc[:, :] = jnp.zeros_like(acc)
+
+    # Causal: K blocks entirely in the future contribute nothing.
+    live = True
+    if causal:
+        live = (k_idx * block_k
+                < (q_idx + 1) * block_q + q_block_offset)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[:, :].astype(jnp.float32) * sm_scale
+        k = k_ref[:, :].astype(jnp.float32)
+        v = v_ref[:, :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        s = _apply_mask(s, q_start=q_idx * block_q,
+                        k_start=k_idx * block_k, kv_actual=kv_actual,
+                        kv_padded=kv_padded, causal=causal,
+                        q_block_offset=q_block_offset)
+        m_prev = m_acc[:, :]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_acc[:, :] = m_new
+        l_acc[:, :] = alpha * l_acc[:, :] + jnp.sum(p, axis=-1,
+                                                    keepdims=True)
+        acc[:, :] = acc[:, :] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(k_idx == num_k_blocks - 1)
+    def _emit():
+        m, l = m_acc[:, :], l_acc[:, :]
+        # Rows with no visible keys: either no block executed (l == 0) or
+        # every entry carried the mask value (m stayed at the mask
+        # floor).  Emit zeros with lse = -inf rather than dividing by
+        # zero / averaging junk.
+        no_valid = jnp.logical_or(l == 0.0, m <= DEFAULT_MASK_VALUE * 0.5)
+        l_safe = jnp.where(no_valid, 1.0, l)
+        o_ref[:, :] = jnp.where(no_valid, 0.0,
+                                acc[:, :] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(no_valid, -jnp.inf, m + jnp.log(l_safe))
+        lse_ref[:, :] = lse.astype(jnp.float32)
 
 
 def _pad_seq(x, multiple):
@@ -204,30 +275,70 @@ def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
     vr = _pad_seq(v.reshape(batch * heads, kv_len, head_dim), block_k)
     q_pad, kv_pad = qr.shape[1], kr.shape[1]
 
-    grid = (batch * heads, q_pad // block_q)
-    kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k,
-        kv_seq_len=kv_pad, kv_actual=kv_len,
-        q_block_offset=q_block_offset)
     out_shape = [
         jax.ShapeDtypeStruct((batch * heads, q_pad, head_dim), q.dtype),
         jax.ShapeDtypeStruct((batch * heads, q_pad, 1), jnp.float32),
     ]
+    if kv_pad <= _resident_max_seq():
+        o, lse = pl.pallas_call(
+            functools.partial(
+                _fwd_kernel_resident, sm_scale=sm_scale, causal=causal,
+                block_k=block_k, kv_seq_len=kv_pad, kv_actual=kv_len,
+                q_block_offset=q_block_offset),
+            grid=(batch * heads, q_pad // block_q),
+            in_specs=[
+                pl.BlockSpec((None, block_q, head_dim),
+                             lambda b, i: (b, i, 0)),
+                pl.BlockSpec((None, kv_pad, head_dim),
+                             lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((None, kv_pad, head_dim),
+                             lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, block_q, head_dim),
+                             lambda b, i: (b, i, 0)),
+                pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            ],
+            out_shape=out_shape,
+            interpret=interpret,
+        )(qr, kr, vr)
+        return (o[:, :q_len].reshape(batch, heads, q_len, head_dim),
+                lse[:, :q_len].reshape(batch, heads, q_len))
+
+    grid = (batch * heads, q_pad // block_q, kv_pad // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, kv_actual=kv_len,
+        kv_padded=kv_pad, q_block_offset=q_block_offset)
+    # Causal: K blocks past the diagonal are skipped in the kernel
+    # (pl.when); clamping their index map to the last live block makes
+    # the block index repeat, so Pallas elides the dead cells' DMA too.
+    if causal:
+        def kv_index(b, i, j):
+            hi = ((i + 1) * block_q + q_block_offset - 1) // block_k
+            return (b, jnp.minimum(j, jnp.maximum(hi, 0)), 0)
+    else:
+        def kv_index(b, i, j):
+            return (b, j, 0)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, head_dim),
-                         lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, kv_pad, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, kv_pad, head_dim), lambda b, i: (b, 0, 0)),
+                         lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, head_dim), kv_index),
+            pl.BlockSpec((None, block_k, head_dim), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, head_dim),
-                         lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+                         lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
     return (o[:, :q_len].reshape(batch, heads, q_len, head_dim),
@@ -238,7 +349,29 @@ def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
 # Backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+
+def _bwd_p_ds(q, k, v, do, lse, delta, *, sm_scale, q_start, k_start,
+              kv_actual, kv_padded, causal, q_block_offset):
+    """(p, ds) for one (q_block, k_block) tile — THE backward math,
+    shared by all four backward kernels (resident + streaming dKdV/dQ)
+    so the short-seq and long-seq paths cannot diverge.
+    p = exp(s - lse); fully-masked rows have lse = -inf -> p = 0;
+    masked entries underflow exp(MASK - lse) -> 0."""
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+    s = _apply_mask(s, q_start=q_start, k_start=k_start,
+                    kv_actual=kv_actual, kv_padded=kv_padded,
+                    causal=causal, q_block_offset=q_block_offset)
+    p = jnp.exp(s - jnp.where(jnp.isfinite(lse), lse, 0.0))
+    p = jnp.where(jnp.isfinite(lse), p, 0.0)
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * sm_scale
+    return p, ds
+
+
+# Resident backward kernels (short-seq fast path): whole Q (dKdV
+# pass) / whole K,V (dQ pass) held in VMEM, in-kernel fori_loop
+# walks the blocks.  See _resident_max_seq.
+def _bwd_dkdv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dk_ref, dv_ref, *, sm_scale: float, causal: bool,
                      block_q: int, q_seq_len: int, kv_actual: int,
                      q_block_offset: int):
@@ -260,18 +393,11 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[pl.ds(qb * block_q, block_q), :]
         delta = delta_ref[pl.ds(qb * block_q, block_q), :]
-        s = jnp.dot(q, k.T,
-                    preferred_element_type=jnp.float32) * sm_scale
-        s = _apply_mask(s, q_start=qb * block_q, k_start=k_idx * block_k,
-                        kv_actual=kv_actual, kv_padded=kv_padded,
-                        causal=causal, q_block_offset=q_block_offset)
-        # p = exp(s - lse); fully-masked rows have lse = -inf → p = 0;
-        # masked entries underflow exp(MASK - lse) → 0.
-        p = jnp.exp(s - jnp.where(jnp.isfinite(lse), lse, 0.0))
-        p = jnp.where(jnp.isfinite(lse), p, 0.0)
+        p, ds = _bwd_p_ds(q, k, v, do, lse, delta, sm_scale=sm_scale,
+                          q_start=qb * block_q, k_start=k_idx * block_k,
+                          kv_actual=kv_actual, kv_padded=kv_padded,
+                          causal=causal, q_block_offset=q_block_offset)
         dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -287,7 +413,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[:, :] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, *, sm_scale: float, causal: bool, block_k: int,
                    kv_seq_len: int, kv_actual: int, q_block_offset: int):
     """Grid cell (batch*head, q_block): accumulate dQ over k blocks."""
@@ -305,15 +431,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def body(kb, dq):
         k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T,
-                    preferred_element_type=jnp.float32) * sm_scale
-        s = _apply_mask(s, q_start=q_idx * block_q, k_start=kb * block_k,
-                        kv_actual=kv_actual, kv_padded=kv_seq_len,
-                        causal=causal, q_block_offset=q_block_offset)
-        p = jnp.exp(s - jnp.where(jnp.isfinite(lse), lse, 0.0))
-        p = jnp.where(jnp.isfinite(lse), p, 0.0)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        _, ds = _bwd_p_ds(q, k, v, do, lse, delta, sm_scale=sm_scale,
+                          q_start=q_idx * block_q, k_start=kb * block_k,
+                          kv_actual=kv_actual, kv_padded=kv_seq_len,
+                          causal=causal, q_block_offset=q_block_offset)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     if causal:
@@ -324,6 +445,160 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         hi = num_k_blocks
     dq = jax.lax.fori_loop(0, hi, body, dq_init)
     dq_ref[:, :] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale: float,
+                     causal: bool, kv_actual: int, kv_padded: int,
+                     q_block_offset: int):
+    """Grid cell (batch*head, k_block, q_block): one q-block contribution
+    to this k-block's dK/dV, accumulated in f32 VMEM scratch across the
+    (sequential, innermost) q dimension.
+
+    Streaming q block-by-block through the grid keeps the kernel's VMEM
+    working set O(block) — a whole-q operand would scale with sequence
+    length and blow the vmem limit around seq 8K (seen in practice)."""
+    block_q = q_ref.shape[0]
+    block_k = k_ref.shape[0]
+    k_idx = pl.program_id(1)
+    q_idx = pl.program_id(2)
+    num_q_blocks = pl.num_programs(2)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_acc[:, :] = jnp.zeros_like(dk_acc)
+        dv_acc[:, :] = jnp.zeros_like(dv_acc)
+
+    # Causal: q blocks strictly before this k block see none of it.
+    live = True
+    if causal:
+        live = ((q_idx + 1) * block_q + q_block_offset
+                > k_idx * block_k)
+
+    @pl.when(live)
+    def _accumulate():
+        k = k_ref[:, :].astype(jnp.float32)
+        v = v_ref[:, :].astype(jnp.float32)
+        q = q_ref[:, :].astype(jnp.float32)
+        do = do_ref[:, :].astype(jnp.float32)
+        lse = lse_ref[:, :]
+        delta = delta_ref[:, :]
+        p, ds = _bwd_p_ds(q, k, v, do, lse, delta, sm_scale=sm_scale,
+                          q_start=q_idx * block_q,
+                          k_start=k_idx * block_k, kv_actual=kv_actual,
+                          kv_padded=kv_padded, causal=causal,
+                          q_block_offset=q_block_offset)
+        dv_acc[:, :] += jnp.dot(p.T, do,
+                                preferred_element_type=jnp.float32)
+        dk_acc[:, :] += jnp.dot(ds.T, q,
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == num_q_blocks - 1)
+    def _emit():
+        dk_ref[:, :] = dk_acc[:, :].astype(dk_ref.dtype)
+        dv_ref[:, :] = dv_acc[:, :].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, sm_scale: float, causal: bool,
+                   kv_actual: int, kv_padded: int, q_block_offset: int):
+    """Grid cell (batch*head, q_block, k_block): one k-block contribution
+    to this q-block's dQ, accumulated in f32 VMEM scratch across the
+    (sequential, innermost) k dimension — same streaming rationale as
+    :func:`_bwd_dkdv_kernel`."""
+    block_q = q_ref.shape[0]
+    block_k = k_ref.shape[0]
+    q_idx = pl.program_id(1)
+    k_idx = pl.program_id(2)
+    num_k_blocks = pl.num_programs(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        dq_acc[:, :] = jnp.zeros_like(dq_acc)
+
+    live = True
+    if causal:
+        live = (k_idx * block_k
+                < (q_idx + 1) * block_q + q_block_offset)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[:, :].astype(jnp.float32)
+        do = do_ref[:, :].astype(jnp.float32)
+        lse = lse_ref[:, :]
+        delta = delta_ref[:, :]
+        k = k_ref[:, :].astype(jnp.float32)
+        v = v_ref[:, :].astype(jnp.float32)
+        _, ds = _bwd_p_ds(q, k, v, do, lse, delta, sm_scale=sm_scale,
+                          q_start=q_idx * block_q,
+                          k_start=k_idx * block_k, kv_actual=kv_actual,
+                          kv_padded=kv_padded, causal=causal,
+                          q_block_offset=q_block_offset)
+        dq_acc[:, :] += jnp.dot(ds, k,
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(k_idx == num_k_blocks - 1)
+    def _emit():
+        dq_ref[:, :] = dq_acc[:, :].astype(dq_ref.dtype)
+
+
+def _flash_backward_resident(q, k, v, qr, kr, vr, dor, lser, deltar, *,
+                             sm_scale, causal, bq, bk, q_block_offset,
+                             interpret):
+    """Short-seq backward: 2D grids with the streamed side resident in
+    VMEM (see _resident_max_seq)."""
+    batch, heads, q_len, head_dim = q.shape
+    kv_len = k.shape[2]
+    q_pad, kv_pad = qr.shape[1], kr.shape[1]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel_resident, sm_scale=sm_scale,
+                          causal=causal, block_q=bq, q_seq_len=q_pad,
+                          kv_actual=kv_len,
+                          q_block_offset=q_block_offset),
+        grid=(batch * heads, kv_pad // bk),
+        in_specs=[
+            pl.BlockSpec((None, q_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, q_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, q_pad, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, q_pad, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, kv_pad, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((batch * heads, kv_pad, head_dim), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_resident, sm_scale=sm_scale,
+                          causal=causal, block_k=bk, kv_seq_len=kv_pad,
+                          kv_actual=kv_len,
+                          q_block_offset=q_block_offset),
+        grid=(batch * heads, q_pad // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, kv_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, kv_pad, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, head_dim),
+                               lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, q_pad, head_dim),
+                                       q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)
+
+    rs = lambda x, n: x[:, :n].reshape(batch, heads, n, head_dim)
+    return rs(dq, q_len), rs(dk, kv_len), rs(dv, kv_len)
 
 
 def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
@@ -359,27 +634,55 @@ def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
     deltar = _pad_seq(flat(delta[..., None]), bq)
     q_pad, kv_pad = qr.shape[1], kr.shape[1]
 
+    if max(q_pad, kv_pad) <= _resident_max_seq():
+        return _flash_backward_resident(
+            q, k, v, qr, kr, vr, dor, lser, deltar, sm_scale=sm_scale,
+            causal=causal, bq=bq, bk=bk, q_block_offset=q_block_offset,
+            interpret=interpret)
+
+    n_qb = q_pad // bq
+    # Causal DMA elision, as in the forward: dkdv's dead cells are q
+    # blocks before the diagonal (clamp up); dq's are K blocks past it
+    # (clamp down).
+    if causal:
+        def q_index(b, i, j):
+            lo = (i * bk - q_block_offset) // bq
+            return (b, jnp.maximum(j, jnp.clip(lo, 0, n_qb - 1)), 0)
+
+        def kv_index(b, i, j):
+            hi = ((i + 1) * bq + q_block_offset - 1) // bk
+            return (b, jnp.minimum(j, jnp.maximum(hi, 0)), 0)
+    else:
+        def q_index(b, i, j):
+            return (b, j, 0)
+
+        kv_index = q_index
+
     dkdv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=bq, q_seq_len=q_pad,
-                          kv_actual=kv_len,
+                          causal=causal, kv_actual=kv_len,
+                          kv_padded=kv_pad,
                           q_block_offset=q_block_offset),
-        grid=(batch * heads, kv_pad // bk),
+        grid=(batch * heads, kv_pad // bk, n_qb),
         in_specs=[
-            pl.BlockSpec((None, q_pad, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, q_pad, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, q_pad, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, q_pad, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bq, head_dim), q_index),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, head_dim), q_index),
+            pl.BlockSpec((None, bq, 1), q_index),
+            pl.BlockSpec((None, bq, 1), q_index),
         ],
         out_specs=[
-            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, bk, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, head_dim), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((batch * heads, kv_pad, head_dim), k.dtype),
             jax.ShapeDtypeStruct((batch * heads, kv_pad, head_dim), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, head_dim), jnp.float32),
+            pltpu.VMEM((bk, head_dim), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr, dor, lser, deltar)
@@ -387,21 +690,22 @@ def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=bk, kv_seq_len=kv_pad, kv_actual=kv_len,
+                          kv_actual=kv_len, kv_padded=kv_pad,
                           q_block_offset=q_block_offset),
-        grid=(batch * heads, q_pad // bq),
+        grid=(batch * heads, q_pad // bq, kv_pad // bk),
         in_specs=[
-            pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, kv_pad, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, kv_pad, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, bq, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, head_dim), kv_index),
+            pl.BlockSpec((None, bk, head_dim), kv_index),
+            pl.BlockSpec((None, bq, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((None, bq, head_dim),
-                               lambda b, i: (b, i, 0)),
+                               lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((batch * heads, q_pad, head_dim),
                                        q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, head_dim), jnp.float32)],
         interpret=interpret,
     )(qr, kr, vr, dor, lser, deltar)
 

@@ -144,6 +144,94 @@ def test_parallel_gradients_match_single_device():
         assert np.max(np.abs(np.asarray(a) - np.asarray(b))) < 5e-4
 
 
+def test_remat_matches_exact_gradients():
+    # cfg.remat must change memory/FLOPs only — loss and gradients are
+    # bit-compatible with the non-remat trace (same ops, same order).
+    import dataclasses
+
+    params, tokens, targets = _data(batch=4)
+    base = make_loss_fn(CFG, ParallelAxes(data=None), mesh_axes=())
+    remat_cfg = dataclasses.replace(CFG, remat=True)
+    rem = make_loss_fn(remat_cfg, ParallelAxes(data=None), mesh_axes=())
+
+    l0, g0 = jax.jit(jax.value_and_grad(
+        lambda p: base(p, (tokens, targets))))(params)
+    l1, g1 = jax.jit(jax.value_and_grad(
+        lambda p: rem(p, (tokens, targets))))(params)
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_chunked_loss_matches_dense():
+    # cfg.loss_chunk must change memory only: loss and gradients match
+    # the full-logits computation.
+    import dataclasses
+
+    params, tokens, targets = _data(batch=4)
+    dense = make_loss_fn(CFG, ParallelAxes(data=None), mesh_axes=())
+    chunked_cfg = dataclasses.replace(CFG, loss_chunk=8)
+    chunked = make_loss_fn(chunked_cfg, ParallelAxes(data=None),
+                           mesh_axes=())
+
+    l0, g0 = jax.jit(jax.value_and_grad(
+        lambda p: dense(p, (tokens, targets))))(params)
+    l1, g1 = jax.jit(jax.value_and_grad(
+        lambda p: chunked(p, (tokens, targets))))(params)
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_chunked_loss_composes_with_seq_parallel():
+    import dataclasses
+
+    mesh = make_mesh(data=2, seq=4)
+    ax = ParallelAxes(data="data", seq="seq")
+    cfg = dataclasses.replace(CFG, loss_chunk=4, remat=True)
+    params, tokens, targets = _data(batch=4)
+    loss_fn = make_loss_fn(cfg, ax, mesh_axes=mesh.axis_names)
+    sm = jax.shard_map(loss_fn, mesh=mesh,
+                       in_specs=(P(), P("data", "seq")), out_specs=P(),
+                       check_vma=False)
+    loss, grads = jax.jit(jax.value_and_grad(sm))(params,
+                                                  (tokens, targets))
+    single = make_loss_fn(CFG, ParallelAxes(data=None), mesh_axes=())
+    want_l, want_g = jax.jit(jax.value_and_grad(
+        lambda p: single(p, (tokens, targets))))(params)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(want_l),
+                               rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(grads),
+                    jax.tree_util.tree_leaves(want_g)):
+        assert np.max(np.abs(np.asarray(a) - np.asarray(b))) < 5e-4
+
+
+def test_remat_composes_with_parallel_axes():
+    import dataclasses
+
+    mesh = make_mesh(data=2, seq=2, model=2)
+    ax = ParallelAxes(data="data", seq="seq", model="model")
+    cfg = dataclasses.replace(CFG, remat=True)
+    params, tokens, targets = _data(batch=4)
+    loss_fn = make_loss_fn(cfg, ax, mesh_axes=mesh.axis_names)
+    sm = jax.shard_map(loss_fn, mesh=mesh,
+                       in_specs=(P(), P("data", "seq")), out_specs=P(),
+                       check_vma=False)
+    loss, grads = jax.jit(jax.value_and_grad(sm))(params,
+                                                  (tokens, targets))
+    assert np.isfinite(np.asarray(loss))
+    # Against the non-remat single-device reference.
+    single = make_loss_fn(CFG, ParallelAxes(data=None), mesh_axes=())
+    want = jax.jit(jax.grad(lambda p: single(p, (tokens, targets))))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(grads),
+                    jax.tree_util.tree_leaves(want)):
+        assert np.max(np.abs(np.asarray(a) - np.asarray(b))) < 5e-4
+
+
 def test_pipeline_rejects_indivisible_layers():
     mesh = make_mesh(pipe=3, devices=jax.devices()[:3])
     ax = ParallelAxes(data=None, pipe="pipe")
