@@ -67,6 +67,16 @@ def test_two_process_stall_warning_names_missing_rank():
 
 
 @pytest.mark.slow
+def test_two_process_torch_frontend():
+    # Torch frontend end-to-end across real processes: eager tensor
+    # collectives, broadcast_parameters, DistributedOptimizer averaging.
+    pytest.importorskip("torch")
+    out = _launch("torch_frontend")
+    assert "TORCH_OK rank=0" in out
+    assert "TORCH_OK rank=1" in out
+
+
+@pytest.mark.slow
 def test_two_process_spmd_training_step():
     # The static fast path (make_train_step) across real processes:
     # identical loss on every rank, and the per-process local-shard
