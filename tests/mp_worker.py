@@ -49,6 +49,18 @@ def scenario_basic(hvd):
     for i, h in enumerate(hs):
         np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
                                    2.0 * i + 1.0)
+
+    # Sparse allreduce (IndexedSlices -> allgather of values+indices,
+    # the reference's tensorflow/__init__.py:67-78 path) across REAL
+    # processes: rank r contributes row r with value r+1.
+    from horovod_tpu import IndexedSlices
+    from horovod_tpu.ops.sparse import as_dense
+
+    sl = IndexedSlices(jnp.full((1, 2), float(rank + 1), jnp.float32),
+                       jnp.array([rank], jnp.int32), (2, 2))
+    out = hvd.allreduce(sl, average=False, name="sparse.op")
+    np.testing.assert_allclose(np.asarray(as_dense(out)),
+                               [[1.0, 1.0], [2.0, 2.0]])
     print(f"BASIC_OK rank={rank}")
 
 
