@@ -140,7 +140,11 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     head_dim = q_ref.shape[1]
     q_idx = pl.program_id(1)
 
-    q = q_ref[:, :].astype(jnp.float32) * sm_scale
+    # Keep q/k/v in their input dtype for the dots: bf16 operands run the
+    # MXU at full rate (f32 accumulation via preferred_element_type); an
+    # f32 upcast here would halve matmul throughput.  sm_scale is applied
+    # to the f32 scores instead of the (possibly bf16) q.
+    q = q_ref[:, :]
     m_init = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
     l_init = jnp.zeros((block_q, 1), jnp.float32)
     acc_init = jnp.zeros((block_q, head_dim), jnp.float32)
@@ -149,9 +153,10 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     def body(kb, carry):
         m_prev, l_prev, acc = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k.T,
+                    preferred_element_type=jnp.float32) * sm_scale
         s = _apply_mask(s, q_start=q_idx * block_q, k_start=kb * block_k,
                         kv_actual=kv_actual, kv_padded=kv_seq_len,
                         causal=causal, q_block_offset=q_block_offset)
@@ -160,7 +165,8 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                    preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
     if causal:
@@ -212,10 +218,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_acc, l_acc, acc,
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[:, :].astype(jnp.float32) * sm_scale
-        k = k_ref[:, :].astype(jnp.float32)
-        v = v_ref[:, :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        # Native-dtype dots (see _fwd_kernel_resident): bf16 operands keep
+        # the MXU at full rate; scores/state accumulate in f32.
+        q = q_ref[:, :]
+        k = k_ref[:, :]
+        v = v_ref[:, :]
+        s = jnp.dot(q, k.T,
+                    preferred_element_type=jnp.float32) * sm_scale
         s = _apply_mask(s, q_start=q_idx * block_q,
                         k_start=k_idx * block_k, kv_actual=kv_actual,
                         kv_padded=kv_padded, causal=causal,
@@ -229,7 +238,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_acc, l_acc, acc,
         l_acc[:, :] = alpha * l_acc[:, :] + jnp.sum(p, axis=-1,
                                                     keepdims=True)
         acc[:, :] = acc[:, :] * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
     @pl.when(k_idx == num_k_blocks - 1)
     def _emit():
@@ -356,7 +365,11 @@ def _bwd_p_ds(q, k, v, do, lse, delta, *, sm_scale, q_start, k_start,
     shared by all four backward kernels (resident + streaming dKdV/dQ)
     so the short-seq and long-seq paths cannot diverge.
     p = exp(s - lse); fully-masked rows have lse = -inf -> p = 0;
-    masked entries underflow exp(MASK - lse) -> 0."""
+    masked entries underflow exp(MASK - lse) -> 0.
+
+    q/k/v/do arrive in their input dtype and feed the MXU directly (f32
+    accumulation); p/ds come out f32 and the callers cast them back to
+    the operand dtype at their own dot sites."""
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
     s = _apply_mask(s, q_start=q_start, k_start=k_start,
                     kv_actual=kv_actual, kv_padded=kv_padded,
@@ -381,24 +394,26 @@ def _bwd_dkdv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_idx = pl.program_id(1)
     kv_padded = pl.num_programs(1) * block_k
 
-    k = k_ref[:, :].astype(jnp.float32)
-    v = v_ref[:, :].astype(jnp.float32)
+    k = k_ref[:, :]
+    v = v_ref[:, :]
     dk_init = jnp.zeros((block_k, head_dim), jnp.float32)
     dv_init = jnp.zeros((block_k, head_dim), jnp.float32)
     num_q_blocks = pl.cdiv(q_seq_len, block_q)
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.ds(qb * block_q, block_q), :]
+        do = do_ref[pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[pl.ds(qb * block_q, block_q), :]
         delta = delta_ref[pl.ds(qb * block_q, block_q), :]
         p, ds = _bwd_p_ds(q, k, v, do, lse, delta, sm_scale=sm_scale,
                           q_start=qb * block_q, k_start=k_idx * block_k,
                           kv_actual=kv_actual, kv_padded=kv_padded,
                           causal=causal, q_block_offset=q_block_offset)
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        dv = dv + jnp.dot(p.astype(do.dtype).T, do,
+                          preferred_element_type=jnp.float32)
+        dk = dk + jnp.dot(ds.astype(q.dtype).T, q,
+                          preferred_element_type=jnp.float32)
         return dk, dv
 
     if causal:
@@ -421,21 +436,22 @@ def _bwd_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     head_dim = q_ref.shape[1]
     q_idx = pl.program_id(1)
 
-    q = q_ref[:, :].astype(jnp.float32)
-    do = do_ref[:, :].astype(jnp.float32)
+    q = q_ref[:, :]
+    do = do_ref[:, :]
     lse = lse_ref[:, :]
     delta = delta_ref[:, :]
     dq_init = jnp.zeros((block_q, head_dim), jnp.float32)
     num_k_blocks = pl.cdiv(kv_seq_len, block_k)
 
     def body(kb, dq):
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
         _, ds = _bwd_p_ds(q, k, v, do, lse, delta, sm_scale=sm_scale,
                           q_start=q_idx * block_q, k_start=kb * block_k,
                           kv_actual=kv_actual, kv_padded=kv_seq_len,
                           causal=causal, q_block_offset=q_block_offset)
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        return dq + jnp.dot(ds.astype(k.dtype), k,
+                            preferred_element_type=jnp.float32)
 
     if causal:
         hi = jnp.minimum(
@@ -477,10 +493,10 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _accumulate():
-        k = k_ref[:, :].astype(jnp.float32)
-        v = v_ref[:, :].astype(jnp.float32)
-        q = q_ref[:, :].astype(jnp.float32)
-        do = do_ref[:, :].astype(jnp.float32)
+        k = k_ref[:, :]
+        v = v_ref[:, :]
+        q = q_ref[:, :]
+        do = do_ref[:, :]
         lse = lse_ref[:, :]
         delta = delta_ref[:, :]
         p, ds = _bwd_p_ds(q, k, v, do, lse, delta, sm_scale=sm_scale,
@@ -488,9 +504,9 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           k_start=k_idx * block_k, kv_actual=kv_actual,
                           kv_padded=kv_padded, causal=causal,
                           q_block_offset=q_block_offset)
-        dv_acc[:, :] += jnp.dot(p.T, do,
+        dv_acc[:, :] += jnp.dot(p.astype(do.dtype).T, do,
                                 preferred_element_type=jnp.float32)
-        dk_acc[:, :] += jnp.dot(ds.T, q,
+        dk_acc[:, :] += jnp.dot(ds.astype(q.dtype).T, q,
                                 preferred_element_type=jnp.float32)
 
     @pl.when(q_idx == num_q_blocks - 1)
@@ -523,18 +539,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[:, :].astype(jnp.float32)
-        do = do_ref[:, :].astype(jnp.float32)
+        q = q_ref[:, :]
+        do = do_ref[:, :]
         lse = lse_ref[:, :]
         delta = delta_ref[:, :]
-        k = k_ref[:, :].astype(jnp.float32)
-        v = v_ref[:, :].astype(jnp.float32)
+        k = k_ref[:, :]
+        v = v_ref[:, :]
         _, ds = _bwd_p_ds(q, k, v, do, lse, delta, sm_scale=sm_scale,
                           q_start=q_idx * block_q,
                           k_start=k_idx * block_k, kv_actual=kv_actual,
                           kv_padded=kv_padded, causal=causal,
                           q_block_offset=q_block_offset)
-        dq_acc[:, :] += jnp.dot(ds, k,
+        dq_acc[:, :] += jnp.dot(ds.astype(k.dtype), k,
                                 preferred_element_type=jnp.float32)
 
     @pl.when(k_idx == num_k_blocks - 1)
@@ -615,8 +631,10 @@ def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
     bq = min(block_q, q_len)
     bk = min(block_k, kv_len)
 
-    do = g.astype(jnp.float32)
-    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)  # [B,H,Sq]
+    do = g.astype(q.dtype)  # native dtype into the kernels' MXU dots
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)  # [B,H,Sq], f32
+
 
     flat = lambda x: x.reshape(batch * heads, x.shape[2], -1)
     # Pad tails to block multiples.  Padded q rows carry lse = -inf so
