@@ -130,6 +130,11 @@ def scenario_dead_worker(hvd):
     from horovod_tpu import HorovodError
 
     rank = hvd.rank()
+    # Barrier first so every rank is fully initialized and connected
+    # before the victim dies — otherwise, under machine load, the death
+    # can land mid-startup on a slow survivor and surface as a different
+    # error than the pending-op diagnosis this test is about.
+    hvd.allreduce(jnp.ones((1,)), name="pre.death.barrier", average=False)
     # The last rank dies; EVERY survivor (controller and plain workers
     # alike) must get a diagnosed failure and exit promptly.
     if rank < hvd.size() - 1:
