@@ -49,6 +49,7 @@ from .ops.collective import (  # noqa: F401
     shard,
     synchronize,
 )
+from .ops.compression import Compression  # noqa: F401
 from .ops.sparse import IndexedSlices  # noqa: F401
 from .parallel.data import (  # noqa: F401
     DistributedOptimizer,

@@ -33,21 +33,29 @@ Usage parity::
 
 from __future__ import annotations
 
+import os
 from types import SimpleNamespace
 from typing import Optional
 
 import jax
 import numpy as np
 
+# Keras 3 binds its backend at first import; this frontend needs the JAX
+# backend.  Setting the default here covers the common case (horovod_tpu
+# imported before keras); if keras was already imported on another
+# backend, DistributedOptimizer raises a diagnosis below.
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
 from ..core import state as _state
 from ..core.state import (cross_rank, cross_size, init,  # noqa: F401
                           is_initialized, local_rank, local_size,
                           mpi_threads_supported, rank, shutdown, size)
 from ..ops import collective as _C
+from ..ops.compression import Compression  # noqa: F401  (hvd.Compression)
 from ..parallel import data as _D
 
 
-def _reduce_grads(grads, average: bool):
+def _reduce_grads(grads, average: bool, compression=None):
     """Dual-path gradient reduction shared with the optax wrapper."""
     leaves = [g for g in grads if g is not None]
     if not leaves:
@@ -55,7 +63,8 @@ def _reduce_grads(grads, average: bool):
     traced = any(isinstance(g, jax.core.Tracer) for g in leaves)
     if traced:
         if _D._in_replica_context():
-            red = iter(_D.allreduce_gradients(leaves, average=average))
+            red = iter(_D.allreduce_gradients(leaves, average=average,
+                                              compression=compression))
             return [next(red) if g is not None else None for g in grads]
         if _state.is_initialized() and _state.global_state().multiprocess:
             # N separate jitted programs cannot be synced by a pass-
@@ -77,12 +86,13 @@ def _reduce_grads(grads, average: bool):
         raise _state.NotInitializedError()
     if _state.size() <= 1:
         return grads
-    red = iter(_D._eager_allreduce_grads(leaves, average=average))
+    red = iter(_D._eager_allreduce_grads(leaves, average=average,
+                                         compression=compression))
     return [next(red) if g is not None else None for g in grads]
 
 
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
-                         average: bool = True):
+                         average: bool = True, compression=None):
     """Wrap a ``keras.optimizers.Optimizer`` so gradients are averaged
     across replicas before the update.
 
@@ -97,14 +107,24 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     """
     import keras
 
+    if keras.backend.backend() != "jax":
+        raise RuntimeError(
+            f"horovod_tpu.frontends.keras needs Keras on the JAX backend, "
+            f"but keras was already imported with backend "
+            f"'{keras.backend.backend()}' (importing tensorflow first can "
+            f"cause this).  Set KERAS_BACKEND=jax before the first keras "
+            f"import.")
+
     base = optimizer.__class__
 
     def _apply(self, grads, trainable_variables=None):
-        grads = _reduce_grads(list(grads), self._hvd_average)
+        grads = _reduce_grads(list(grads), self._hvd_average,
+                              self._hvd_compression)
         return super(cls, self).apply(grads, trainable_variables)
 
     cls = type(base.__name__, (base,),
                {"apply": _apply, "_hvd_average": average,
+                "_hvd_compression": compression,
                 "_hvd_name": name or f"Distributed{base.__name__}"})
     config = optimizer.get_config()
     return cls.from_config(config) if hasattr(cls, "from_config") \

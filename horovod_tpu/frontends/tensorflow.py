@@ -42,6 +42,7 @@ from ..core.state import (cross_rank, cross_size, init,  # noqa: F401
                           mpi_threads_supported, rank, shutdown, size)
 from ..ops import collective as _C
 from ..ops import sparse as _S
+from ..ops.compression import Compression  # noqa: F401  (hvd.Compression)
 
 
 def _tf():
@@ -73,11 +74,15 @@ def _wrap(out, like: np.ndarray):
     return tf.constant(np.asarray(out).astype(like.dtype, copy=False))
 
 
-def allreduce(tensor, average: bool = True, name: Optional[str] = None):
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              compression=None):
     """Allreduce a ``tf.Tensor``/``tf.Variable``/``tf.IndexedSlices``.
 
     IndexedSlices dispatch to the sparse gather-of-(values, indices)
-    exchange exactly like the reference (tensorflow/__init__.py:67-78).
+    exchange exactly like the reference (tensorflow/__init__.py:67-78);
+    they already ship a minimal payload, so ``compression`` (the dense
+    wire cast, ``hvd.Compression.fp16``/``bf16``) applies to dense
+    tensors only.
     """
     tf = _tf()
     if isinstance(tensor, tf.IndexedSlices):
@@ -96,7 +101,11 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
             dense_shape=None if dense_shape is None
             else tf.constant(dense_shape, dtype="int64"))
     arr = _to_numpy(tensor)
-    return _wrap(_C.allreduce(arr, average=average, name=name), arr)
+    if compression is None:
+        return _wrap(_C.allreduce(arr, average=average, name=name), arr)
+    wire, ctx = compression.compress(arr)
+    red = _C.allreduce(wire, average=average, name=name)
+    return _wrap(compression.decompress(red, ctx), arr)
 
 
 def allgather(tensor, name: Optional[str] = None):
@@ -136,9 +145,10 @@ class DistributedGradientTape:
     gradients — the TF2 idiom for the reference's DistributedOptimizer
     ``compute_gradients`` override (tensorflow/__init__.py:158-177)."""
 
-    def __init__(self, tape, average: bool = True):
+    def __init__(self, tape, average: bool = True, compression=None):
         self._tape = tape
         self._average = average
+        self._compression = compression
 
     def __getattr__(self, item):
         return getattr(self.__dict__["_tape"], item)
@@ -154,28 +164,40 @@ class DistributedGradientTape:
         tf = _tf()
         grads = self._tape.gradient(target, sources, *args, **kwargs)
         flat = tf.nest.flatten(grads)
-        red = _allreduce_batch(flat, self._average, prefix="tape.grad")
+        red = _allreduce_batch(flat, self._average, prefix="tape.grad",
+                               compression=self._compression)
         return tf.nest.pack_sequence_as(grads, red)
 
 
-def _allreduce_batch(tensors, average: bool, prefix: str) -> List[Any]:
+def _allreduce_batch(tensors, average: bool, prefix: str,
+                     compression=None) -> List[Any]:
     """Fire every allreduce async, then synchronize — so the runtime's
     tensor fusion batches the small gradients into one collective
-    (ops/collective.py fused buckets) instead of N round trips."""
+    (ops/collective.py fused buckets) instead of N round trips.
+    ``compression`` casts the wire payload down; ``_wrap`` restores each
+    gradient's original dtype on the way out."""
+    comp = compression
     arrs = [None if t is None else _to_numpy(t) for t in tensors]
-    handles = [
-        None if a is None else _C.allreduce_async(
-            a, average=average, name=f"{prefix}.{i}")
-        for i, a in enumerate(arrs)
-    ]
+    handles, ctxs = [], []
+    for i, a in enumerate(arrs):
+        if a is None:
+            handles.append(None)
+            ctxs.append(None)
+            continue
+        wire, ctx = (a, None) if comp is None else comp.compress(a)
+        handles.append(_C.allreduce_async(wire, average=average,
+                                          name=f"{prefix}.{i}"))
+        ctxs.append(ctx)
     return [
-        None if h is None else _wrap(_C.synchronize(h), arrs[i])
+        None if h is None else _wrap(
+            _C.synchronize(h) if comp is None
+            else comp.decompress(_C.synchronize(h), ctxs[i]), arrs[i])
         for i, h in enumerate(handles)
     ]
 
 
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
-                         average: bool = True):
+                         average: bool = True, compression=None):
     """Wrap a ``tf.keras`` optimizer so ``apply_gradients`` allreduces
     the gradients first (≙ reference DistributedOptimizer,
     tensorflow/__init__.py:135-192, minus the TF1 graph machinery).
@@ -183,6 +205,7 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     class's name."""
     base = optimizer.__class__
     overrides = {"_hvd_average": average,
+                 "_hvd_compression": compression,
                  "_hvd_name": name or f"Distributed{base.__name__}"}
 
     if hasattr(base, "apply"):
@@ -192,7 +215,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
         # frontends/keras.py).
         def _apply(self, grads, trainable_variables=None):
             red = _allreduce_batch(list(grads), self._hvd_average,
-                                   prefix="grad")
+                                   prefix="grad",
+                                   compression=self._hvd_compression)
             return super(cls, self).apply(red, trainable_variables)
 
         overrides["apply"] = _apply
@@ -201,7 +225,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
         def _apply_gradients(self, grads_and_vars, *args, **kwargs):
             gv = list(grads_and_vars)
             red = _allreduce_batch([g for g, _ in gv], self._hvd_average,
-                                   prefix="grad")
+                                   prefix="grad",
+                                   compression=self._hvd_compression)
             return super(cls, self).apply_gradients(
                 [(r, v) for r, (_, v) in zip(red, gv)], *args, **kwargs)
 

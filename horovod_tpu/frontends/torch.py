@@ -32,7 +32,7 @@ Notes vs the reference:
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Optional, Tuple
+from typing import Dict, Iterable, NamedTuple, Optional
 
 import numpy as np
 
@@ -42,13 +42,23 @@ from ..core import state as _state
 from ..core.state import (init, is_initialized, local_rank, local_size,  # noqa: F401
                           mpi_threads_supported, rank, shutdown, size)
 from ..ops import collective as _C
+from ..ops.compression import Compression  # noqa: F401  (hvd.Compression)
 
-# handle -> (target tensor for in-place write-back or None, torch dtype).
-# Strong references (the target may be a temporary view object like
-# ``p.data`` whose storage we must mutate); ``poll`` releases the entry as
-# soon as it observes completion by performing the write-back eagerly, so
-# polled-and-abandoned handles do not pin tensors.
-_inplace_targets: Dict[int, Tuple[Optional[torch.Tensor], torch.dtype]] = {}
+# handle -> pending-op record.  Strong references (the target may be a
+# temporary view object like ``p.data`` whose storage we must mutate);
+# ``poll`` releases the entry as soon as it observes completion by
+# performing the write-back eagerly, so polled-and-abandoned handles do
+# not pin tensors.
+
+
+class _Pending(NamedTuple):
+    target: Optional[torch.Tensor]  # in-place write-back target, or None
+    dtype: torch.dtype              # original torch dtype to restore
+    compression: Optional[object]   # hvd.Compression.* or None
+    ctx: Optional[object]           # compressor context (original dtype)
+
+
+_inplace_targets: Dict[int, _Pending] = {}
 
 
 def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
@@ -72,38 +82,59 @@ def _from_numpy(arr, dtype: torch.dtype) -> torch.Tensor:
 
 
 def _enqueue(op: str, tensor: torch.Tensor, *, inplace: bool,
-             name: Optional[str], **kw) -> int:
+             name: Optional[str], compression=None, **kw) -> int:
     arr = _to_numpy(tensor)
+    ctx = None
+    if compression is not None:
+        arr, ctx = compression.compress(arr)
     fn = getattr(_C, f"{op}_async")
     handle = fn(arr, name=name, **kw)
-    _inplace_targets[handle] = (tensor if inplace else None, tensor.dtype)
+    _inplace_targets[handle] = _Pending(tensor if inplace else None,
+                                        tensor.dtype, compression, ctx)
     return handle
 
 
+def _finalize(entry: Optional[_Pending], raw) -> np.ndarray:
+    """Decompress the wire result (if this handle was compressed) and
+    bridge back to numpy."""
+    if entry is not None and entry.compression is not None:
+        raw = entry.compression.decompress(raw, entry.ctx)
+    return np.asarray(raw)
+
+
 def _write_back(handle: int, result: np.ndarray) -> Optional[torch.Tensor]:
-    """Copy ``result`` into the handle's in-place target (if any), release
-    the map entry, and return the target tensor."""
-    target, dtype = _inplace_targets.pop(handle, (None, None))
-    if target is None:
+    """Copy the finalized ``result`` into the handle's in-place target (if
+    any), release the tensor reference, and return the target tensor.
+    The (tensor-free) record stays until ``synchronize`` pops it — a
+    synchronize after a poll-side write-back still needs the dtype and
+    decompression context to shape its return value."""
+    entry = _inplace_targets.get(handle)
+    if entry is None or entry.target is None:
         return None
-    out = _from_numpy(result, dtype)
-    if target.shape != out.shape:
-        target.resize_(out.shape)
-    target.copy_(out)
-    return target
+    out = _from_numpy(result, entry.dtype)
+    if entry.target.shape != out.shape:
+        entry.target.resize_(out.shape)
+    entry.target.copy_(out)
+    _inplace_targets[handle] = entry._replace(target=None)
+    return entry.target
 
 
 def poll(handle: int) -> bool:
     """Non-blocking completion check (≙ horovod_torch_poll,
     torch/mpi_ops.py:318-325).  On completion the in-place write-back
     happens immediately and the target reference is released, so a
-    polled-then-abandoned handle never pins the caller's tensor."""
+    polled-then-abandoned handle never pins the caller's tensor.  The
+    tensor-free record stays until ``synchronize`` — it carries the
+    dtype and compression context a later synchronize needs to
+    decompress and shape its return value."""
     done = _C.poll(handle)
     if done:
-        st = _state.global_state()
-        h = st.handle_manager._get(handle)
-        if not isinstance(h.result, _C.HorovodError):
-            _write_back(handle, np.asarray(h.result))
+        entry = _inplace_targets.get(handle)
+        if entry is not None and entry.target is not None:
+            st = _state.global_state()
+            h = st.handle_manager._get(handle)
+            if not isinstance(h.result, _C.HorovodError):
+                _write_back(handle, _finalize(entry, h.result))
     return done
 
 
@@ -111,12 +142,15 @@ def synchronize(handle: int) -> torch.Tensor:
     """Block until ``handle`` completes; returns the result tensor (and
     copies it into the original for in-place ops) —
     ≙ torch/mpi_ops.py:328-344."""
-    dtype = _inplace_targets.get(handle, (None, None))[1]
-    result = np.asarray(_C.synchronize(handle))
+    entry = _inplace_targets.get(handle)
+    result = _finalize(entry, _C.synchronize(handle))
     target = _write_back(handle, result)
+    _inplace_targets.pop(handle, None)
     if target is not None:
         return target
-    if dtype is None:
+    if entry is not None:
+        dtype = entry.dtype
+    else:
         dtype = torch.from_numpy(result).dtype
     return _from_numpy(result, dtype)
 
@@ -124,25 +158,28 @@ def synchronize(handle: int) -> torch.Tensor:
 # -- allreduce --------------------------------------------------------------
 
 def allreduce_async(tensor, average: bool = True,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None, compression=None) -> int:
     return _enqueue("allreduce", tensor, inplace=False, name=name,
-                    average=average)
+                    compression=compression, average=average)
 
 
 def allreduce_async_(tensor, average: bool = True,
-                     name: Optional[str] = None) -> int:
+                     name: Optional[str] = None, compression=None) -> int:
     return _enqueue("allreduce", tensor, inplace=True, name=name,
-                    average=average)
+                    compression=compression, average=average)
 
 
-def allreduce(tensor, average: bool = True,
-              name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(allreduce_async(tensor, average, name))
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              compression=None) -> torch.Tensor:
+    """``compression`` (``hvd.Compression.fp16``/``bf16``) casts the
+    tensor down for the wire and restores its dtype after — the kwarg
+    contract Horovod later standardized for this API."""
+    return synchronize(allreduce_async(tensor, average, name, compression))
 
 
-def allreduce_(tensor, average: bool = True,
-               name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(allreduce_async_(tensor, average, name))
+def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
+               compression=None) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average, name, compression))
 
 
 # -- allgather --------------------------------------------------------------
@@ -213,9 +250,10 @@ class _DistributedOptimizer:
 
     def __init__(self, optimizer: torch.optim.Optimizer,
                  named_parameters: Optional[Iterable] = None,
-                 average: bool = True):
+                 average: bool = True, compression=None):
         self._inner = optimizer
         self._average = average
+        self._compression = compression
         if named_parameters is not None:
             named = list(named_parameters)
         else:
@@ -254,7 +292,8 @@ class _DistributedOptimizer:
             name = self._param_names.get(
                 p, f"allreduce.noname.{id(p)}")
             self._handles[p] = allreduce_async_(
-                p.grad, average=self._average, name=f"grad.{name}")
+                p.grad, average=self._average, name=f"grad.{name}",
+                compression=self._compression)
 
         return hook
 
@@ -279,7 +318,11 @@ class _DistributedOptimizer:
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          named_parameters: Optional[Iterable] = None,
-                         average: bool = True) -> _DistributedOptimizer:
+                         average: bool = True,
+                         compression=None) -> _DistributedOptimizer:
     """Distributed wrapper for any ``torch.optim.Optimizer``
-    (≙ hvd.DistributedOptimizer, torch/__init__.py:90-122)."""
-    return _DistributedOptimizer(optimizer, named_parameters, average)
+    (≙ hvd.DistributedOptimizer, torch/__init__.py:90-122).
+    ``compression=hvd.Compression.fp16`` matches the kwarg GPU Horovod
+    scripts pass (bf16 recommended on TPU)."""
+    return _DistributedOptimizer(optimizer, named_parameters, average,
+                                 compression)

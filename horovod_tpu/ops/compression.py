@@ -1,0 +1,105 @@
+"""Gradient compression for the allreduce wire (≙ hvd.Compression).
+
+The reference snapshot (v0.13.0) predates Horovod's compression API; this
+implements the contract Horovod later standardized (horovod.torch
+``Compression.fp16``): gradients are cast down before the collective and
+restored after, halving the bytes every allreduce moves.  On TPU the
+collective rides ICI, so the win is ICI/DCN bandwidth — most valuable on
+the DCN (multi-slice) axis of a hybrid mesh.
+
+TPU note: prefer :data:`Compression.bf16` — bfloat16 keeps float32's
+exponent range (gradients overflow easily in float16's 5-bit exponent)
+and is the MXU-native dtype.  ``fp16`` is provided for drop-in parity
+with GPU Horovod scripts: every ``DistributedOptimizer`` (the core optax
+wrapper and the torch/keras/tensorflow frontends) and the torch/tf
+``allreduce`` functions accept the same ``compression=`` kwarg.
+
+Usage (core JAX surface)::
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01),
+                                   compression=hvd.Compression.bf16)
+
+or explicitly around a single collective::
+
+    compressor = hvd.Compression.bf16
+    t, ctx = compressor.compress(tensor)
+    out = compressor.decompress(hvd.allreduce(t, average=True), ctx)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["Compression", "Compressor", "NoneCompressor", "FP16Compressor",
+           "BF16Compressor"]
+
+
+class Compressor:
+    """Interface: ``compress(tensor) -> (tensor, ctx)`` before the wire,
+    ``decompress(tensor, ctx)`` after.  Pure casts — safe both inside jit
+    (the static psum path) and on eager numpy-backed arrays."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (≙ Horovod's Compression.none)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: jnp.dtype = None  # set by subclasses
+
+    @classmethod
+    def compress(cls, tensor):
+        tensor = jnp.asarray(tensor)
+        dtype = tensor.dtype
+        # Only floating inputs wider than the wire dtype are compressed;
+        # integer/bool tensors and already-narrow floats pass through
+        # (casting int64 indices to fp16 would corrupt them).
+        if (jnp.issubdtype(dtype, jnp.floating)
+                and jnp.dtype(dtype).itemsize
+                > jnp.dtype(cls.wire_dtype).itemsize):
+            return tensor.astype(cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is None:
+            return tensor
+        return jnp.asarray(tensor).astype(ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    """float16 wire dtype (≙ Horovod's Compression.fp16).  Mind the 5-bit
+    exponent: loss-scale or prefer bf16 on TPU."""
+
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """bfloat16 wire dtype — float32 exponent range, MXU-native; the
+    recommended compressor on TPU."""
+
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Namespace matching Horovod's ``hvd.Compression`` surface."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -63,7 +63,8 @@ def _fusion_threshold_bytes() -> int:
 
 
 def allreduce_gradients(grads, average: bool = True,
-                        fusion_threshold: Optional[int] = None):
+                        fusion_threshold: Optional[int] = None,
+                        compression=None):
     """Cross-replica gradient reduction with Tensor Fusion bucketing.
 
     Must be called inside a replica-axis trace (shard_map/pmap).  Gradients
@@ -73,18 +74,32 @@ def allreduce_gradients(grads, average: bool = True,
     overlap the collectives.  A threshold of 0 disables fusion (one psum
     per tensor, reference docs/tensor-fusion.md).
 
+    ``compression`` (a :class:`~horovod_tpu.ops.compression.Compressor`,
+    e.g. ``hvd.Compression.bf16``) casts dense gradients down for the
+    wire and restores the dtype after — sparse leaves already ship a
+    minimal payload and pass through uncompressed.
+
     :class:`~horovod_tpu.ops.sparse.IndexedSlices` leaves exchange as an
     all_gather of (values, indices) — the reference's sparse branch
     (tensorflow/__init__.py:67-78) — and stay sparse in the result.
     """
+    from ..ops.compression import NoneCompressor
     from ..ops.sparse import IndexedSlices
 
+    compression = compression or NoneCompressor
     threshold = (_fusion_threshold_bytes()
                  if fusion_threshold is None else fusion_threshold)
     leaves, treedef = jax.tree_util.tree_flatten(
         grads, is_leaf=lambda g: isinstance(g, IndexedSlices))
     if not leaves:
         return grads
+    # Compress dense leaves for the wire; remember each ctx for the
+    # decompress after the reduction.  Bucketing below then groups by the
+    # *compressed* dtype, so fused buckets stay narrow end-to-end.
+    ctxs: list = [None] * len(leaves)
+    for i, g in enumerate(leaves):
+        if not isinstance(g, IndexedSlices):
+            leaves[i], ctxs[i] = compression.compress(g)
     denom = None
     if average:
         # Under shard_map the axis size is static.
@@ -102,7 +117,9 @@ def allreduce_gradients(grads, average: bool = True,
 
     if threshold <= 0:
         red = [gather_sparse(g) if isinstance(g, IndexedSlices)
-               else finish(jax.lax.psum(g, REPLICA_AXIS)) for g in leaves]
+               else compression.decompress(
+                   finish(jax.lax.psum(g, REPLICA_AXIS)), ctx)
+               for g, ctx in zip(leaves, ctxs)]
         return jax.tree_util.tree_unflatten(treedef, red)
 
     # Bucket by dtype, preserving leaf order for unflatten.  Sparse leaves
@@ -143,17 +160,21 @@ def allreduce_gradients(grads, average: bool = True,
             bucket.append(i)
             bucket_bytes += nbytes
         flush(bucket)
+    out = [o if ctx is None else compression.decompress(o, ctx)
+           for o, ctx in zip(out, ctxs)]
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def _eager_allreduce_grads(grads, average: bool = True):
+def _eager_allreduce_grads(grads, average: bool = True, compression=None):
     """Dynamic-path gradient reduction: fire all allreduces async, then
     synchronize — the Torch hook + step() pattern (torch/__init__.py:62-87),
     with coordinator-level fusion batching the small tensors.  Sparse
     (IndexedSlices) leaves take the allgather exchange transparently."""
     from ..ops import collective as C
     from ..ops import sparse as S
+    from ..ops.compression import NoneCompressor
 
+    compression = compression or NoneCompressor
     leaves, treedef = jax.tree_util.tree_flatten(
         grads, is_leaf=lambda g: isinstance(g, S.IndexedSlices))
 
@@ -180,19 +201,21 @@ def _eager_allreduce_grads(grads, average: bool = True):
                             C.allgather_async(g.indices,
                                               name=f"grad.{i}.indices")))
         else:
-            handles.append(C.allreduce_async(g, average=average,
-                                             name=f"grad.{i}"))
+            wire, ctx = compression.compress(g)
+            handles.append((ctx, C.allreduce_async(wire, average=average,
+                                                   name=f"grad.{i}")))
     denom = _state.contributor_count()
     red = []
     for h in handles:
-        if isinstance(h, tuple):
+        if len(h) == 3:
             g, hv, hi = h
             values = C.synchronize(hv)
             red.append(S.IndexedSlices(
                 values / denom if average else values,
                 C.synchronize(hi), g.dense_shape))
         else:
-            red.append(C.synchronize(h))
+            ctx, handle = h
+            red.append(compression.decompress(C.synchronize(handle), ctx))
     return jax.tree_util.tree_unflatten(treedef, red)
 
 
@@ -212,7 +235,8 @@ class DistributedOptimizer:
 
     def __init__(self, optimizer, average: bool = True,
                  fusion_threshold: Optional[int] = None,
-                 name: Optional[str] = None, sparse_as_dense: bool = False):
+                 name: Optional[str] = None, sparse_as_dense: bool = False,
+                 compression=None):
         self._inner = optimizer
         self._average = average
         self._fusion_threshold = fusion_threshold
@@ -221,6 +245,9 @@ class DistributedOptimizer:
         # choice (tensorflow/__init__.py:49-60): True forces sparse grads
         # through the dense psum path (cheaper when most rows are touched).
         self._sparse_as_dense = sparse_as_dense
+        # hvd.Compression.{none,fp16,bf16}: cast dense grads down for the
+        # wire, restore after (bf16 recommended on TPU).
+        self._compression = compression
 
     def init(self, params):
         return self._inner.init(params)
@@ -240,9 +267,11 @@ class DistributedOptimizer:
         if _in_replica_context():
             grads = allreduce_gradients(
                 grads, average=self._average,
-                fusion_threshold=self._fusion_threshold)
+                fusion_threshold=self._fusion_threshold,
+                compression=self._compression)
         elif _state.is_initialized() and _state.size() > 1:
-            grads = _eager_allreduce_grads(grads, average=self._average)
+            grads = _eager_allreduce_grads(grads, average=self._average,
+                                           compression=self._compression)
         elif _state.is_initialized():
             pass  # size 1: reduction is the identity (reference behaves the
             #       same — collectives still run but are trivial).

@@ -137,10 +137,12 @@ def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
     pmean placement, shard_map specs and donation for both variants."""
     mesh = mesh or _state.mesh()
 
+    compression = None
     if isinstance(optimizer, DistributedOptimizer):
         average = optimizer._average
         if optimizer._fusion_threshold is not None:
             fusion_threshold = optimizer._fusion_threshold
+        compression = optimizer._compression
         optimizer = optimizer._inner
 
     # The stateful loss returns (loss, new_state) — an aux output.
@@ -153,7 +155,8 @@ def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
         aux = out[1] if (has_aux or has_state) else None
         # Fused cross-replica gradient reduction (Tensor Fusion over psum).
         grads = allreduce_gradients(grads, average=average,
-                                    fusion_threshold=fusion_threshold)
+                                    fusion_threshold=fusion_threshold,
+                                    compression=compression)
         # Report the global mean loss, like MetricAverageCallback would
         # (keras/callbacks.py:37-87).  Aux outputs — metrics, or the
         # updated BatchNorm statistics in the stateful variant — are

@@ -20,6 +20,11 @@ os.environ["XLA_FLAGS"] = (
 # Keep test runs off the real TPU tunnel (see memory: axon-cpu-test-env).
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Keras 3 binds its backend at first import.  TF ships keras, so a test
+# file importing tensorflow before test_keras_frontend.py would silently
+# bind the TF backend and hand the keras frontend symbolic tf.Tensors;
+# pin the JAX backend for every ordering.
+os.environ.setdefault("KERAS_BACKEND", "jax")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,200 @@
+"""Gradient compression (hvd.Compression.{none,fp16,bf16}).
+
+The reference snapshot predates Horovod's compression API; these tests
+pin the contract Horovod later standardized: gradients are cast down for
+the wire and restored after, the result keeps the original dtype, and
+the compressed reduction stays within the wire dtype's tolerance of the
+uncompressed one — on both the static (fused psum) and eager
+(async-handle) paths.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd_api
+from horovod_tpu.models.mnist import (MnistMLP, cross_entropy_loss,
+                                      init_params, synthetic_mnist)
+from horovod_tpu.ops.compression import Compression
+from horovod_tpu.parallel.training import make_train_step, shard_batch
+
+
+def test_compress_roundtrip_dtypes():
+    t = jnp.arange(8, dtype=jnp.float32) / 3.0
+    for comp in (Compression.fp16, Compression.bf16):
+        wire, ctx = comp.compress(t)
+        assert wire.dtype == comp.wire_dtype
+        back = comp.decompress(wire, ctx)
+        assert back.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(back), np.asarray(t),
+                                   rtol=1e-2)
+
+
+def test_non_float_and_narrow_tensors_pass_through():
+    idx = jnp.arange(8, dtype=jnp.int32)
+    wire, ctx = Compression.bf16.compress(idx)
+    assert wire.dtype == jnp.int32 and ctx is None
+    half = jnp.ones((4,), jnp.bfloat16)
+    wire, ctx = Compression.fp16.compress(half)
+    assert wire.dtype == jnp.bfloat16 and ctx is None
+    assert Compression.none.compress(idx) == (idx, None)
+
+
+def _loss_fn(model):
+    def loss_fn(params, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": params}, images),
+                                  labels)
+    return loss_fn
+
+
+@pytest.mark.parametrize("comp", [Compression.bf16, Compression.fp16])
+def test_static_path_compressed_matches_uncompressed(hvd, comp):
+    """Inside shard_map: compressed fused reduction ~= exact, and the
+    updated parameters keep their f32 dtype."""
+    model = MnistMLP(hidden=32)
+    params = init_params(model)
+    opt = optax.sgd(0.1)
+    images, labels = synthetic_mnist(64)
+    batch = shard_batch((jnp.asarray(images), jnp.asarray(labels)))
+
+    outs = []
+    for compression in (None, comp):
+        dopt = hvd_api.DistributedOptimizer(opt, compression=compression)
+        step = make_train_step(_loss_fn(model), dopt, donate=False)
+        p, _, _ = step(params, dopt.init(params), batch)
+        outs.append(p)
+    for exact, compressed in zip(jax.tree_util.tree_leaves(outs[0]),
+                                 jax.tree_util.tree_leaves(outs[1])):
+        assert compressed.dtype == exact.dtype
+        # One SGD step at lr 0.1: wire-dtype error on the gradient only.
+        np.testing.assert_allclose(np.asarray(compressed),
+                                   np.asarray(exact), atol=5e-3)
+
+
+def test_eager_path_compressed_allreduce_average(hvd):
+    """Eager DistributedOptimizer path: bf16-compressed grads still
+    average to the exact value for exactly-representable inputs."""
+    dopt = hvd_api.DistributedOptimizer(optax.sgd(1.0),
+                                        compression=Compression.bf16)
+    params = {"w": jnp.zeros((4,), jnp.float32)}
+    st = dopt.init(params)
+    grads = {"w": jnp.full((4,), 2.0, jnp.float32)}  # exact in bf16
+    updates, _ = dopt.update(grads, st, params)
+    out = optax.apply_updates(params, updates)["w"]
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), -2.0)
+
+
+def test_compression_composes_with_fusion_thresholds(hvd):
+    """Bucketed and unbucketed compressed reductions agree exactly (the
+    wire dtype is the same either way; bucketing is not a semantic
+    change)."""
+    model = MnistMLP(hidden=32)
+    params = init_params(model)
+    images, labels = synthetic_mnist(64)
+    batch = shard_batch((jnp.asarray(images), jnp.asarray(labels)))
+
+    outs = []
+    for threshold in (0, 1 << 26):
+        dopt = hvd_api.DistributedOptimizer(optax.sgd(0.1),
+                                            fusion_threshold=threshold,
+                                            compression=Compression.bf16)
+        step = make_train_step(_loss_fn(model), dopt, donate=False)
+        p, _, _ = step(params, dopt.init(params), batch)
+        outs.append(p)
+    for a, b in zip(jax.tree_util.tree_leaves(outs[0]),
+                    jax.tree_util.tree_leaves(outs[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_torch_frontend_accepts_compression(hvd):
+    """The torch frontend takes the same compression kwarg GPU Horovod
+    scripts pass: wire is fp16, result restores the torch dtype, and the
+    in-place variant writes back the decompressed value."""
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.frontends.torch as thvd
+
+    t = torch.full((4,), 3.0)
+    out = thvd.allreduce(t, average=True, compression=thvd.Compression.fp16)
+    assert out.dtype == torch.float32
+    np.testing.assert_allclose(out.numpy(), 3.0)
+
+    t2 = torch.full((4,), 5.0)
+    thvd.allreduce_(t2, average=True, compression=thvd.Compression.bf16)
+    np.testing.assert_allclose(t2.numpy(), 5.0)
+
+    # poll-then-synchronize on a non-inplace compressed handle: poll must
+    # not discard the decompression context (regression: poll used to pop
+    # the entry, so synchronize returned the raw bf16 wire array).
+    h = thvd.allreduce_async(torch.full((4,), 7.0), average=True,
+                             compression=thvd.Compression.bf16)
+    while not thvd.poll(h):
+        pass
+    out3 = thvd.synchronize(h)
+    assert out3.dtype == torch.float32
+    np.testing.assert_allclose(out3.numpy(), 7.0)
+
+    # Same poll-then-synchronize sequence on an IN-PLACE compressed
+    # handle (regression: poll's write-back used to pop the whole record,
+    # so synchronize crashed on the raw bf16 wire array).
+    t3 = torch.full((4,), 9.0)
+    h2 = thvd.allreduce_async_(t3, average=True,
+                               compression=thvd.Compression.bf16)
+    while not thvd.poll(h2):
+        pass
+    np.testing.assert_allclose(t3.numpy(), 9.0)  # poll wrote back
+    out4 = thvd.synchronize(h2)
+    assert out4.dtype == torch.float32
+    np.testing.assert_allclose(out4.numpy(), 9.0)
+
+    model = torch.nn.Linear(2, 1, bias=False)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+        compression=thvd.Compression.bf16)
+    loss = model(torch.ones((2, 2))).sum()
+    loss.backward()
+    opt.step()  # hooks fired compressed allreduces; step must not raise
+
+
+def test_tf_frontend_accepts_compression(hvd):
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu.frontends.tensorflow as tfhvd
+
+    out = tfhvd.allreduce(tf.constant([2.0, 4.0]), average=True,
+                          compression=tfhvd.Compression.bf16)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+
+    # DistributedGradientTape takes the same kwarg (GPU Horovod parity).
+    w = tf.Variable([[2.0]])
+    with tfhvd.DistributedGradientTape(
+            tf.GradientTape(), compression=tfhvd.Compression.fp16) as tape:
+        loss = w * w
+    (g,) = tape.gradient(loss, [w])
+    assert g.dtype == tf.float32
+    np.testing.assert_allclose(g.numpy(), [[4.0]])
+
+
+def test_sparse_leaves_bypass_compression(hvd):
+    """IndexedSlices exchange as an uncompressed allgather: indices must
+    never be cast; gathered values keep their dtype."""
+    from horovod_tpu.ops.sparse import IndexedSlices
+
+    dopt = hvd_api.DistributedOptimizer(optax.sgd(1.0),
+                                        compression=Compression.fp16)
+    dense = jnp.zeros((4, 2), jnp.float32)
+    params = {"emb": dense}
+    st = dopt.init(params)
+    grads = {"emb": IndexedSlices(values=jnp.ones((1, 2), jnp.float32),
+                                  indices=jnp.array([1]),
+                                  dense_shape=(4, 2))}
+    updates, _ = dopt.update(grads, st, params)
+    out = optax.apply_updates(params, updates)["emb"]
+    assert out.dtype == jnp.float32
+    # All 8 replicas contributed the same row; averaged update is -1.
+    np.testing.assert_allclose(np.asarray(out)[1], -1.0)
+    np.testing.assert_allclose(np.asarray(out)[0], 0.0)
