@@ -58,7 +58,10 @@ except Exception:  # pragma: no cover - optax is baked into the image
 
 class ZeroTrainStep(NamedTuple):
     """``init(params) -> opt_state`` (sharded) and
-    ``step(params, opt_state, batch) -> (params, opt_state, loss)``."""
+    ``step(params, opt_state, batch) -> (params, opt_state, loss)`` —
+    or, from :func:`make_zero_train_step_with_state`,
+    ``step(params, model_state, opt_state, batch) ->
+    (params, model_state, opt_state, loss)``."""
 
     init: Callable[[Any], Any]
     step: Callable[..., Any]
@@ -99,11 +102,16 @@ def make_zero_train_step(
     average: bool = True,
     compression=None,
     donate: bool = True,
+    has_state: bool = False,
 ) -> ZeroTrainStep:
     """Build a ZeRO-1 data-parallel train step over the replica mesh.
 
     Args:
-      loss_fn: ``loss_fn(params, batch) -> scalar`` on the local shard.
+      loss_fn: ``loss_fn(params, batch) -> scalar`` on the local shard —
+        or, with ``has_state=True``, ``loss_fn(params, model_state,
+        batch) -> (scalar, new_model_state)`` (BatchNorm-style models;
+        the returned state is pmean-synchronized like
+        :func:`~horovod_tpu.parallel.training.make_train_step_with_state`).
       optimizer: an elementwise optax ``GradientTransformation`` (or a
         :class:`DistributedOptimizer` wrapping one — its averaging flag
         and compression are honored; the reduction here is the
@@ -130,14 +138,22 @@ def make_zero_train_step(
             compression = optimizer._compression
         optimizer = optimizer._inner
 
-    grad_fn = jax.value_and_grad(loss_fn)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=has_state)
 
     def per_replica_init(params):
         p_shard, _, _ = _flat_shard(params, n)
         return optimizer.init(p_shard)
 
-    def per_replica_step(params, opt_state, batch):
-        loss, grads = grad_fn(params, batch)
+    def per_replica_step(params, model_state, opt_state, batch):
+        if has_state:
+            (loss, model_state), grads = grad_fn(params, model_state,
+                                                 batch)
+            # Synchronized BatchNorm: stats average over replicas on the
+            # same compiled collective schedule as the gradients.
+            model_state = jax.tree_util.tree_map(
+                lambda x: jax.lax.pmean(x, REPLICA_AXIS), model_state)
+        else:
+            loss, grads = grad_fn(params, batch)
         flat_g, _, _ = _pad_flat(grads, n)
         ctx = None
         if compression is not None:
@@ -158,7 +174,10 @@ def make_zero_train_step(
         flat_p = jax.lax.all_gather(p_shard, REPLICA_AXIS, axis=0,
                                     tiled=True)
         params = unravel_p(flat_p[:true_size])
-        return params, opt_state, jax.lax.pmean(loss, REPLICA_AXIS)
+        loss = jax.lax.pmean(loss, REPLICA_AXIS)
+        if has_state:
+            return params, model_state, opt_state, loss
+        return params, opt_state, loss
 
     # Optimizer states mix vector leaves (momentum/variance slices —
     # sharded over the replica axis) with scalar leaves (e.g. Adam's
@@ -190,20 +209,51 @@ def make_zero_train_step(
 
     step_cache: dict = {}
 
-    def step(params, opt_state, batch):
+    def _compiled(opt_state):
         specs = _state_specs(opt_state)
         key = jax.tree_util.tree_structure(specs), tuple(
             str(s) for s in jax.tree_util.tree_leaves(
                 specs, is_leaf=lambda x: isinstance(x, P)))
         if key not in step_cache:
-            sharded = jax.shard_map(
-                per_replica_step, mesh=mesh,
-                in_specs=(P(), specs, P(REPLICA_AXIS)),
-                out_specs=(P(), specs, P()),
-                check_vma=False)
-            jitted = jax.jit(sharded,
-                             donate_argnums=(0, 1) if donate else ())
+            if has_state:
+                fn = per_replica_step
+                in_specs = (P(), P(), specs, P(REPLICA_AXIS))
+                out_specs = (P(), P(), specs, P())
+                donate_argnums = (0, 1, 2) if donate else ()
+            else:
+                def fn(params, opt_state, batch):
+                    return per_replica_step(params, None, opt_state,
+                                            batch)
+                in_specs = (P(), specs, P(REPLICA_AXIS))
+                out_specs = (P(), specs, P())
+                donate_argnums = (0, 1) if donate else ()
+            jitted = jax.jit(
+                jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False),
+                donate_argnums=donate_argnums)
             step_cache[key] = _throttle_on_cpu(jitted, mesh)
-        return step_cache[key](params, opt_state, batch)
+        return step_cache[key]
+
+    if has_state:
+        def step(params, model_state, opt_state, batch):
+            return _compiled(opt_state)(params, model_state, opt_state,
+                                        batch)
+    else:
+        def step(params, opt_state, batch):
+            return _compiled(opt_state)(params, opt_state, batch)
 
     return ZeroTrainStep(init=init, step=step)
+
+
+def make_zero_train_step_with_state(loss_fn, optimizer, mesh=None,
+                                    average: bool = True,
+                                    compression=None,
+                                    donate: bool = True) -> ZeroTrainStep:
+    """Stateful-model spelling (BatchNorm etc.) of
+    :func:`make_zero_train_step` — ``loss_fn(params, state, batch) ->
+    (loss, state)``; ``step(params, model_state, opt_state, batch) ->
+    (params, model_state, opt_state, loss)`` — mirroring
+    :func:`~horovod_tpu.parallel.training.make_train_step_with_state`."""
+    return make_zero_train_step(loss_fn, optimizer, mesh=mesh,
+                                average=average, compression=compression,
+                                donate=donate, has_state=True)

@@ -103,6 +103,41 @@ def test_zero_unwraps_distributed_optimizer(hvd):
     assert np.isfinite(float(loss))
 
 
+def test_zero_with_state_matches_plain_dp(hvd):
+    """Stateful variant (synchronized BatchNorm): tracks
+    make_train_step_with_state on a thin ResNet."""
+    from horovod_tpu.models.resnet import (ResNet18Thin, init_resnet,
+                                           resnet_loss_fn,
+                                           synthetic_imagenet)
+    from horovod_tpu.parallel.training import make_train_step_with_state
+    from horovod_tpu.parallel.zero import make_zero_train_step_with_state
+
+    model = ResNet18Thin(num_classes=8)
+    params, stats = init_resnet(model, image_size=32, batch_size=2)
+    loss_fn = resnet_loss_fn(model)
+    images, labels = synthetic_imagenet(16, image_size=32, num_classes=8)
+    batch = shard_batch((jnp.asarray(images), jnp.asarray(labels)))
+
+    opt = optax.sgd(0.1, momentum=0.9)
+    plain = make_train_step_with_state(loss_fn, opt, donate=False)
+    zstep = make_zero_train_step_with_state(loss_fn, optax.sgd(
+        0.1, momentum=0.9), donate=False)
+    p1, s1, o1 = params, stats, opt.init(params)
+    p2, s2, o2 = params, stats, zstep.init(params)
+    for _ in range(3):
+        p1, s1, o1, l1 = plain(p1, s1, o1, batch)
+        p2, s2, o2, l2 = zstep.step(p2, s2, o2, batch)
+    np.testing.assert_allclose(float(l2), float(l1), rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(p2),
+                    jax.tree_util.tree_leaves(p1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s2),
+                    jax.tree_util.tree_leaves(s1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-5)
+
+
 def test_zero_composes_with_compression(hvd):
     """bf16-compressed reduce_scatter stays close to the exact step and
     keeps f32 params (also exercised via DistributedOptimizer unwrap)."""
