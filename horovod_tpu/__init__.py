@@ -50,6 +50,7 @@ from .ops.collective import (  # noqa: F401
     synchronize,
 )
 from .ops.compression import Compression  # noqa: F401
+from .ops.objects import allgather_object, broadcast_object  # noqa: F401
 from .ops.sparse import IndexedSlices  # noqa: F401
 from .parallel.data import (  # noqa: F401
     DistributedOptimizer,

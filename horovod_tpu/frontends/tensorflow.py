@@ -43,6 +43,8 @@ from ..core.state import (cross_rank, cross_size, init,  # noqa: F401
 from ..ops import collective as _C
 from ..ops import sparse as _S
 from ..ops.compression import Compression  # noqa: F401  (hvd.Compression)
+from ..ops.objects import (allgather_object,  # noqa: F401  (object API)
+                           broadcast_object)
 
 
 def _tf():

@@ -43,6 +43,8 @@ from ..core.state import (init, is_initialized, local_rank, local_size,  # noqa:
                           mpi_threads_supported, rank, shutdown, size)
 from ..ops import collective as _C
 from ..ops.compression import Compression  # noqa: F401  (hvd.Compression)
+from ..ops.objects import (allgather_object,  # noqa: F401  (object API)
+                           broadcast_object)
 
 # handle -> pending-op record.  Strong references (the target may be a
 # temporary view object like ``p.data`` whose storage we must mutate);

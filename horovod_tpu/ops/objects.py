@@ -1,0 +1,80 @@
+"""Arbitrary-object collectives: ``broadcast_object`` / ``allgather_object``.
+
+The reference snapshot (v0.13.0) predates these; Horovod later added them
+(``hvd.broadcast_object`` appeared for sharing optimizer state and resume
+epochs without hand-rolled tensor packing).  They are pure composition
+over the existing eager collectives:
+
+* ``allgather_object`` — pickle the object to a uint8 vector and ride the
+  variable-dim-0 allgather (the one collective whose negotiation already
+  handles per-rank sizes, ≙ MPIResponse.tensor_sizes); a first allgather
+  of the byte counts gives the split points for unpickling per rank.
+* ``broadcast_object`` — rank ordering of collectives requires every rank
+  to submit a matching shape, so the root first broadcasts the byte count
+  (scalar), then the payload (non-roots contribute a zero buffer of that
+  size, which broadcast semantics discard).
+
+Objects must be picklable.  Only trust peers you would trust with code
+execution — unpickling attacker-controlled bytes runs arbitrary code,
+the same caveat Horovod's own object APIs carry.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Optional
+
+import numpy as np
+
+from . import collective as _C
+
+__all__ = ["allgather_object", "broadcast_object"]
+
+
+def _to_bytes_array(obj: Any) -> np.ndarray:
+    return np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
+    """Gather one picklable object per rank; returns the rank-ordered
+    list on every rank (≙ the post-v0.13 hvd.allgather_object)."""
+    name = name or "allgather.object"
+    data = _to_bytes_array(obj)
+    # The payload gather does not depend on the sizes result — launch
+    # both async so they negotiate in the same coordinator tick (one
+    # cross-process round trip, not two).  int64 sizes: a pickle can
+    # exceed the int32 range.
+    h_sizes = _C.allgather_async(np.array([data.size], dtype=np.int64),
+                                 name=f"{name}.sizes")
+    h_data = _C.allgather_async(data, name=f"{name}.data")
+    sizes = np.asarray(_C.synchronize(h_sizes))
+    payload = np.asarray(_C.synchronize(h_data))
+    out: List[Any] = []
+    off = 0
+    for sz in sizes.tolist():
+        out.append(pickle.loads(payload[off:off + sz].tobytes()))
+        off += sz
+    return out
+
+
+def broadcast_object(obj: Any = None, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Broadcast one picklable object from ``root_rank``; every rank
+    returns the root's object (≙ the post-v0.13 hvd.broadcast_object).
+    Non-root ranks may pass ``obj=None``."""
+    from ..core import state as _state
+
+    name = name or "broadcast.object"
+    is_root = _state.rank() == root_rank
+    if is_root:
+        data = _to_bytes_array(obj)
+        size = np.array([data.size], dtype=np.int64)
+    else:
+        size = np.zeros((1,), dtype=np.int64)
+    size = int(np.asarray(_C.broadcast(size, root_rank,
+                                       name=f"{name}.size"))[0])
+    if not is_root:
+        data = np.zeros((size,), dtype=np.uint8)
+    payload = np.asarray(_C.broadcast(data, root_rank,
+                                      name=f"{name}.data"))
+    return pickle.loads(payload.tobytes())

@@ -61,6 +61,18 @@ def scenario_basic(hvd):
     out = hvd.allreduce(sl, average=False, name="sparse.op")
     np.testing.assert_allclose(np.asarray(as_dense(out)),
                                [[1.0, 1.0], [2.0, 2.0]])
+
+    # Object collectives across REAL processes: per-rank pickles of
+    # genuinely different sizes ride the ragged allgather; broadcast
+    # ships the root's object to the non-root.
+    from horovod_tpu import allgather_object, broadcast_object
+
+    objs = allgather_object({"rank": rank, "pad": "x" * (10 * rank)})
+    assert [o["rank"] for o in objs] == [0, 1], objs
+    assert len(objs[1]["pad"]) == 10
+    got = broadcast_object({"resume": 7} if rank == 0 else None,
+                           root_rank=0)
+    assert got == {"resume": 7}, got
     print(f"BASIC_OK rank={rank}")
 
 

@@ -1,0 +1,33 @@
+"""Object collectives (ops/objects.py): pickle-over-collective contract."""
+
+import numpy as np
+
+import horovod_tpu as hvd_api
+
+
+def test_allgather_object_roundtrip(hvd):
+    obj = {"epoch": 3, "name": "run-a", "metrics": [1.0, 2.5]}
+    out = hvd_api.allgather_object(obj)
+    assert isinstance(out, list) and len(out) == hvd_api.size()
+    for o in out:
+        assert o == obj
+
+
+def test_broadcast_object_returns_root_value(hvd):
+    obj = {"resume_from_epoch": 7, "nested": {"lr": 0.1}}
+    got = hvd_api.broadcast_object(obj, root_rank=0)
+    assert got == obj
+    # Non-root convention: obj=None still returns the root's object
+    # (single-process mode: rank 0 IS the caller, so pass the value).
+    got2 = hvd_api.broadcast_object({"x": np.arange(3)}, root_rank=0)
+    np.testing.assert_array_equal(got2["x"], np.arange(3))
+
+
+def test_object_apis_on_every_frontend(hvd):
+    import horovod_tpu.frontends.keras as khvd
+    import horovod_tpu.frontends.tensorflow as tfhvd
+    import horovod_tpu.frontends.torch as thvd
+
+    for mod in (thvd, tfhvd, khvd):
+        assert mod.allgather_object is hvd_api.allgather_object
+        assert mod.broadcast_object is hvd_api.broadcast_object
