@@ -45,6 +45,8 @@ from .ops.collective import (  # noqa: F401
     allreduce_async,
     broadcast,
     broadcast_async,
+    grouped_allreduce,
+    grouped_allreduce_async,
     poll,
     shard,
     synchronize,

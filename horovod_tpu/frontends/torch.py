@@ -184,6 +184,48 @@ def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
     return synchronize(allreduce_async_(tensor, average, name, compression))
 
 
+def _grouped_allreduce_async(tensors, *, inplace: bool, average: bool,
+                             name: Optional[str], compression) -> list:
+    """Shared body of the four grouped entry points: per-call-unique
+    base name (overlapping anonymous groups must not collide), one
+    handle per tensor, back-to-back enqueue so the fusion queue batches
+    the group (≙ the post-v0.13 hvd.grouped_allreduce API)."""
+    base = name or _C._auto_name("grouped.allreduce")
+    return [_enqueue("allreduce", t, inplace=inplace, name=f"{base}.{i}",
+                     compression=compression, average=average)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_allreduce_async(tensors, average: bool = True,
+                            name: Optional[str] = None,
+                            compression=None) -> list:
+    return _grouped_allreduce_async(tensors, inplace=False,
+                                    average=average, name=name,
+                                    compression=compression)
+
+
+def grouped_allreduce(tensors, average: bool = True,
+                      name: Optional[str] = None,
+                      compression=None) -> list:
+    return [synchronize(h) for h in grouped_allreduce_async(
+        tensors, average, name, compression)]
+
+
+def grouped_allreduce_async_(tensors, average: bool = True,
+                             name: Optional[str] = None,
+                             compression=None) -> list:
+    return _grouped_allreduce_async(tensors, inplace=True,
+                                    average=average, name=name,
+                                    compression=compression)
+
+
+def grouped_allreduce_(tensors, average: bool = True,
+                       name: Optional[str] = None,
+                       compression=None) -> list:
+    return [synchronize(h) for h in grouped_allreduce_async_(
+        tensors, average, name, compression)]
+
+
 # -- allgather --------------------------------------------------------------
 
 def allgather_async(tensor, name: Optional[str] = None) -> int:

@@ -791,6 +791,31 @@ def allreduce_async(tensor, average: bool = True,
                     prefix="allreduce")
 
 
+def grouped_allreduce_async(tensors, average: bool = True,
+                            name: Optional[str] = None) -> List[int]:
+    """Queue a group of allreduces in one call; returns one handle per
+    tensor (≙ the post-v0.13 hvd.grouped_allreduce API).  The group
+    enters the request queue back-to-back, so Tensor Fusion batches it
+    — normally into one wire collective; a concurrent background tick
+    can split a group across two fused responses, which changes wire
+    batching, never results.  The default base name is unique per call
+    so overlapping anonymous groups never collide."""
+    base = name or _auto_name("grouped.allreduce")
+    return [
+        _enqueue(t, RequestType.ALLREDUCE, f"{base}.{i}", average=average,
+                 prefix="allreduce")
+        for i, t in enumerate(tensors)
+    ]
+
+
+def grouped_allreduce(tensors, average: bool = True,
+                      name: Optional[str] = None) -> List:
+    """Synchronous grouped allreduce: fused under the hood, one result
+    per input tensor, input order preserved."""
+    return [synchronize(h)
+            for h in grouped_allreduce_async(tensors, average, name)]
+
+
 def allgather_async(tensor, name: Optional[str] = None) -> int:
     return _enqueue(tensor, RequestType.ALLGATHER, name, prefix="allgather")
 

@@ -149,3 +149,51 @@ def test_allreduce_scalar(hvd):
     workaround."""
     y = hvd.allreduce(jnp.float32(2.5), average=False)
     np.testing.assert_allclose(float(y), 2.5 * hvd.size(), rtol=1e-6)
+
+
+def test_grouped_allreduce_values_and_order(hvd):
+    """Grouped entry point (≙ post-v0.13 hvd.grouped_allreduce): one
+    result per tensor, input order preserved, fused under the hood."""
+    tensors = [jnp.full((i + 1,), float(i + 1)) for i in range(4)]
+    outs = hvd.grouped_allreduce(tensors, average=False)
+    assert len(outs) == 4
+    for i, out in enumerate(outs):
+        assert out.shape == (i + 1,)
+        np.testing.assert_allclose(np.asarray(out), (i + 1.0) * hvd.size())
+    outs = hvd.grouped_allreduce(tensors, average=True)
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(out), i + 1.0)
+
+
+def test_grouped_allreduce_async_handles(hvd):
+    hs = hvd.grouped_allreduce_async(
+        [jnp.ones((2,)), jnp.full((3,), 2.0)], average=False)
+    assert len(hs) == 2
+    a, b = (hvd.synchronize(h) for h in hs)
+    np.testing.assert_allclose(np.asarray(a), float(hvd.size()))
+    np.testing.assert_allclose(np.asarray(b), 2.0 * hvd.size())
+
+
+def test_grouped_allreduce_torch_frontend(hvd):
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.frontends.torch as thvd
+
+    ts = [torch.full((2,), 1.0), torch.full((3,), 3.0)]
+    outs = thvd.grouped_allreduce(ts, average=True)
+    np.testing.assert_allclose(outs[0].numpy(), 1.0)
+    np.testing.assert_allclose(outs[1].numpy(), 3.0)
+    # In-place grouped variant writes back into the callers' tensors.
+    thvd.grouped_allreduce_(ts, average=True)
+    np.testing.assert_allclose(ts[0].numpy(), 1.0)
+    np.testing.assert_allclose(ts[1].numpy(), 3.0)
+
+
+def test_grouped_allreduce_overlapping_anonymous_groups(hvd):
+    """Two anonymous groups in flight at once must not collide on names
+    (the default base is unique per call)."""
+    h1 = hvd.grouped_allreduce_async([jnp.ones((2,))], average=False)
+    h2 = hvd.grouped_allreduce_async([jnp.full((2,), 2.0)], average=False)
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(h1[0])),
+                               float(hvd.size()))
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(h2[0])),
+                               2.0 * hvd.size())
