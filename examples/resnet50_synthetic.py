@@ -35,6 +35,8 @@ def main():
     ap.add_argument("--full", action="store_true",
                     help="benchmark shapes (224px ResNet-50)")
     ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--zero", action="store_true",
+                    help="ZeRO-1: shard optimizer state across replicas")
     args = ap.parse_args()
 
     hvd.init()
@@ -67,7 +69,7 @@ def main():
 
     trainer = Trainer(
         loss_fn, params, lr=base_lr, optimizer_kwargs={"momentum": 0.9},
-        model_state=stats,
+        model_state=stats, zero=args.zero,
         callbacks=[
             callbacks.BroadcastGlobalVariablesCallback(0),
             callbacks.MetricAverageCallback(),

@@ -43,13 +43,20 @@ class Trainer:
       lr: initial learning rate (``initial_lr`` in callback terms).
       callbacks: list of callback objects (see :mod:`horovod_tpu.callbacks`).
       model_state: optional non-trained model state (e.g. BatchNorm stats).
+      zero: ZeRO-1 optimizer-state sharding (see
+        :mod:`horovod_tpu.parallel.zero`; the optimizer must be
+        elementwise — tree-wide transforms like ``clip_by_global_norm``
+        would see only their local shard).  ``fusion_threshold`` does
+        not apply in this mode: the flattened gradient is one maximal
+        fusion bucket.
     """
 
     def __init__(self, loss_fn, params, optimizer_fn=optax.sgd,
                  lr: float = 0.01, optimizer_kwargs: Optional[dict] = None,
                  callbacks: Optional[Sequence] = None, model_state=None,
                  average_gradients: bool = True,
-                 fusion_threshold: Optional[int] = None):
+                 fusion_threshold: Optional[int] = None,
+                 zero: bool = False):
         _state._check_initialized()
         self.params = params
         self.model_state = model_state
@@ -58,13 +65,32 @@ class Trainer:
         self._momentum_key = "momentum" if "momentum" in kwargs else None
         self.optimizer = optax.inject_hyperparams(optimizer_fn)(
             learning_rate=lr, **kwargs)
-        self.opt_state = self.optimizer.init(params)
-        if self._has_state:
-            self._step = make_train_step_with_state(
-                loss_fn, self.optimizer, average=average_gradients,
-                fusion_threshold=fusion_threshold, donate=False)
+        if zero:
+            # ZeRO-1: sharded optimizer state (parallel/zero.py).  The
+            # step/opt_state contracts match the replicated builders, so
+            # callbacks (LR mutation included — hyperparams are
+            # replicated scalar leaves) work unchanged.
+            from ..parallel.zero import (make_zero_train_step,
+                                         make_zero_train_step_with_state)
+
+            if fusion_threshold is not None:
+                import warnings
+
+                warnings.warn(
+                    "fusion_threshold is ignored with zero=True: the "
+                    "flattened gradient is one maximal fusion bucket",
+                    stacklevel=2)
+            builder = (make_zero_train_step_with_state if self._has_state
+                       else make_zero_train_step)
+            zstep = builder(loss_fn, self.optimizer,
+                            average=average_gradients, donate=False)
+            self.opt_state = zstep.init(params)
+            self._step = zstep.step
         else:
-            self._step = make_train_step(
+            self.opt_state = self.optimizer.init(params)
+            builder = (make_train_step_with_state if self._has_state
+                       else make_train_step)
+            self._step = builder(
                 loss_fn, self.optimizer, average=average_gradients,
                 fusion_threshold=fusion_threshold, donate=False)
         self.callbacks = list(callbacks or [])

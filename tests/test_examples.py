@@ -76,3 +76,11 @@ def test_resnet50_synthetic_example():
     out = _run_example("resnet50_synthetic.py", args=("--epochs", "1"))
     assert "epoch 0:" in out
     assert "checkpoint saved" in out
+    # Resume the SAME checkpoint through the ZeRO-1 trainer: the
+    # params/stats checkpoint is optimizer-layout-agnostic, so plain-DP
+    # and sharded-optimizer runs interoperate.
+    out = _run_example("resnet50_synthetic.py",
+                       args=("--epochs", "2", "--zero"))
+    assert "resumed from epoch 1" in out
+    assert "epoch 1:" in out
+    assert "checkpoint saved" in out
