@@ -32,7 +32,8 @@ Notes vs the reference:
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, NamedTuple, Optional
+import weakref
+from typing import Dict, Iterable, Optional
 
 import numpy as np
 
@@ -48,16 +49,28 @@ from ..ops.objects import (allgather_object,  # noqa: F401  (object API)
 
 # handle -> pending-op record.  Strong references (the target may be a
 # temporary view object like ``p.data`` whose storage we must mutate);
-# ``poll`` releases the entry as soon as it observes completion by
-# performing the write-back eagerly, so polled-and-abandoned handles do
-# not pin tensors.
+# ``poll`` consumes the result as soon as it observes completion by
+# performing the write-back eagerly and releasing the underlying handle,
+# so polled-and-abandoned in-place handles (the fire-and-forget pattern)
+# pin neither the caller's tensor nor the in-flight jax.Array.  After the
+# write-back only a weak reference to the target survives — enough for a
+# later ``synchronize`` to honor the reference's identity contract
+# (synchronize returns the mutated input tensor, torch/mpi_ops.py:328-344)
+# without re-pinning it, and the weakref's callback evicts the record
+# when the target dies so the table cannot grow without bound.
 
 
-class _Pending(NamedTuple):
-    target: Optional[torch.Tensor]  # in-place write-back target, or None
-    dtype: torch.dtype              # original torch dtype to restore
-    compression: Optional[object]   # hvd.Compression.* or None
-    ctx: Optional[object]           # compressor context (original dtype)
+class _Pending:
+    __slots__ = ("target", "dtype", "compression", "ctx", "done", "wref")
+
+    def __init__(self, target: Optional[torch.Tensor], dtype: torch.dtype,
+                 compression: Optional[object], ctx: Optional[object]):
+        self.target = target        # in-place write-back target, or None
+        self.dtype = dtype          # original torch dtype to restore
+        self.compression = compression  # hvd.Compression.* or None
+        self.ctx = ctx              # compressor context (original dtype)
+        self.done = False           # poll-side write-back already happened
+        self.wref = None            # weakref to the target after write-back
 
 
 _inplace_targets: Dict[int, _Pending] = {}
@@ -104,52 +117,65 @@ def _finalize(entry: Optional[_Pending], raw) -> np.ndarray:
     return np.asarray(raw)
 
 
-def _write_back(handle: int, result: np.ndarray) -> Optional[torch.Tensor]:
-    """Copy the finalized ``result`` into the handle's in-place target (if
-    any), release the tensor reference, and return the target tensor.
-    The (tensor-free) record stays until ``synchronize`` pops it — a
-    synchronize after a poll-side write-back still needs the dtype and
-    decompression context to shape its return value."""
-    entry = _inplace_targets.get(handle)
-    if entry is None or entry.target is None:
-        return None
+def _write_back(entry: _Pending, result: np.ndarray) -> torch.Tensor:
+    """Copy the finalized ``result`` into ``entry.target``, downgrade the
+    strong target reference to a weak one, and return the target."""
+    target = entry.target
     out = _from_numpy(result, entry.dtype)
-    if entry.target.shape != out.shape:
-        entry.target.resize_(out.shape)
-    entry.target.copy_(out)
-    _inplace_targets[handle] = entry._replace(target=None)
-    return entry.target
+    if target.shape != out.shape:
+        target.resize_(out.shape)
+    target.copy_(out)
+    entry.target = None
+    entry.done = True
+    return target
 
 
 def poll(handle: int) -> bool:
     """Non-blocking completion check (≙ horovod_torch_poll,
-    torch/mpi_ops.py:318-325).  On completion the in-place write-back
-    happens immediately and the target reference is released, so a
-    polled-then-abandoned handle never pins the caller's tensor.  The
-    tensor-free record stays until ``synchronize`` — it carries the
-    dtype and compression context a later synchronize needs to
-    decompress and shape its return value."""
+    torch/mpi_ops.py:318-325).  On completion of an in-place op the
+    write-back happens immediately and BOTH the target reference and the
+    underlying handle (with its in-flight jax.Array) are released, so a
+    polled-then-abandoned handle pins nothing.  A tiny weakref record
+    survives for a later ``synchronize`` to return the original tensor
+    (the reference's identity contract); its death callback evicts the
+    record when the target is collected."""
+    entry = _inplace_targets.get(handle)
+    if entry is not None and entry.done:
+        return True
     done = _C.poll(handle)
-    if done:
-        entry = _inplace_targets.get(handle)
-        if entry is not None and entry.target is not None:
-            st = _state.global_state()
-            h = st.handle_manager._get(handle)
-            if not isinstance(h.result, _C.HorovodError):
-                _write_back(handle, _finalize(entry, h.result))
+    if done and entry is not None and entry.target is not None:
+        st = _state.global_state()
+        h = st.handle_manager._get(handle)
+        if not isinstance(h.result, _C.HorovodError):
+            # Non-blocking: poll() just observed readiness.  synchronize
+            # runs the handle's own finalizer and releases it from the
+            # manager, un-pinning the device-side result.
+            target = _write_back(entry, _finalize(entry,
+                                                  _C.synchronize(handle)))
+            entry.wref = weakref.ref(
+                target, lambda _r, h=handle: _inplace_targets.pop(h, None))
     return done
 
 
 def synchronize(handle: int) -> torch.Tensor:
     """Block until ``handle`` completes; returns the result tensor (and
-    copies it into the original for in-place ops) —
-    ≙ torch/mpi_ops.py:328-344."""
+    copies it into the original for in-place ops, returning that same
+    tensor object) — ≙ torch/mpi_ops.py:328-344."""
     entry = _inplace_targets.get(handle)
-    result = _finalize(entry, _C.synchronize(handle))
-    target = _write_back(handle, result)
-    _inplace_targets.pop(handle, None)
-    if target is not None:
+    if entry is not None and entry.done:
+        # poll() already consumed the result and released the handle.
+        _inplace_targets.pop(handle, None)
+        target = entry.wref() if entry.wref is not None else None
+        if target is None:
+            raise ValueError(
+                f"Handle {handle} completed via poll() and its in-place "
+                "target tensor has since been garbage-collected; the "
+                "result was written into that tensor and is gone with it.")
         return target
+    result = _finalize(entry, _C.synchronize(handle))
+    _inplace_targets.pop(handle, None)
+    if entry is not None and entry.target is not None:
+        return _write_back(entry, result)
     if entry is not None:
         dtype = entry.dtype
     else:

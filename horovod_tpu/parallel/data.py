@@ -106,6 +106,9 @@ def allreduce_gradients(grads, average: bool = True,
         denom = jax.lax.psum(jnp.ones((), jnp.float32), REPLICA_AXIS)
 
     def finish(x):
+        # Applied AFTER decompress for dense leaves, so averaging divides
+        # in the restored dtype (f32), not the narrow wire dtype —
+        # matching the ZeRO-1 path's numerics (zero.py) at no wire cost.
         return (x / denom.astype(x.dtype)) if average else x
 
     def gather_sparse(g):
@@ -117,8 +120,8 @@ def allreduce_gradients(grads, average: bool = True,
 
     if threshold <= 0:
         red = [gather_sparse(g) if isinstance(g, IndexedSlices)
-               else compression.decompress(
-                   finish(jax.lax.psum(g, REPLICA_AXIS)), ctx)
+               else finish(compression.decompress(
+                   jax.lax.psum(g, REPLICA_AXIS), ctx))
                for g, ctx in zip(leaves, ctxs)]
         return jax.tree_util.tree_unflatten(treedef, red)
 
@@ -141,11 +144,11 @@ def allreduce_gradients(grads, average: bool = True,
                 return
             if len(bucket) == 1:
                 i = bucket[0]
-                out[i] = finish(jax.lax.psum(leaves[i], REPLICA_AXIS))
+                out[i] = jax.lax.psum(leaves[i], REPLICA_AXIS)
                 return
             flat = jnp.concatenate(
                 [jnp.ravel(leaves[i]) for i in bucket])
-            red = finish(jax.lax.psum(flat, REPLICA_AXIS))
+            red = jax.lax.psum(flat, REPLICA_AXIS)
             off = 0
             for i in bucket:
                 n = leaves[i].size
@@ -160,8 +163,9 @@ def allreduce_gradients(grads, average: bool = True,
             bucket.append(i)
             bucket_bytes += nbytes
         flush(bucket)
-    out = [o if ctx is None else compression.decompress(o, ctx)
-           for o, ctx in zip(out, ctxs)]
+    out = [o if isinstance(g, IndexedSlices)
+           else finish(compression.decompress(o, ctx))
+           for o, g, ctx in zip(out, leaves, ctxs)]
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

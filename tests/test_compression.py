@@ -74,6 +74,50 @@ def test_static_path_compressed_matches_uncompressed(hvd, comp):
                                    np.asarray(exact), atol=5e-3)
 
 
+def test_compressed_average_divides_after_decompress():
+    """Averaging divides in the RESTORED dtype (f32) after decompress,
+    matching the ZeRO-1 path's numerics — not in the narrow wire dtype
+    (advisor round-3 finding).  A 5-replica mesh makes the two orders
+    bit-distinguishable (division by 5 is inexact in bfloat16)."""
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel.data import allreduce_gradients
+
+    hvd_api.init(devices=jax.devices()[:5])
+    try:
+        n = hvd_api.size()
+        assert n == 5
+        mesh = hvd_api.mesh()
+        # Full 8-bit-mantissa value (255/128): the 5-way sum cannot be
+        # held exactly in bf16, so sum/5 is inexact in bf16 but has a
+        # closer f32 representation — the two division orders differ.
+        g = jnp.full((n, 1, 4), 1.9921875, jnp.float32)
+
+        def step(avg):
+            def body(x):
+                x = jnp.squeeze(x, 0)
+                out = allreduce_gradients(
+                    {"w": x}, average=avg,
+                    compression=Compression.bf16)["w"]
+                return out[None]
+            return jax.jit(jax.shard_map(
+                body, mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"),
+                check_vma=False))
+
+        avg = np.asarray(step(True)(hvd_api.shard(g)))[0, 0]
+        summed = np.asarray(step(False)(hvd_api.shard(g)))[0, 0]
+        # New order: decompress (exact bf16->f32) then divide in f32.
+        expected = summed / np.float32(n)
+        # Old order: divide the wire-dtype sum in bf16, then decompress.
+        old = np.asarray((jnp.asarray(summed).astype(jnp.bfloat16)
+                          / jnp.asarray(n, jnp.bfloat16))
+                         .astype(jnp.float32))
+        assert not np.array_equal(old, expected), "test lost its teeth"
+        np.testing.assert_array_equal(avg, expected)
+    finally:
+        hvd_api.shutdown()
+
+
 def test_eager_path_compressed_allreduce_average(hvd):
     """Eager DistributedOptimizer path: bf16-compressed grads still
     average to the exact value for exactly-representable inputs."""

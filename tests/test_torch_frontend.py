@@ -63,6 +63,72 @@ def test_allreduce_async_poll_synchronize(thvd):
         thvd.poll(h)
 
 
+def _poll_until_done(thvd, h, timeout=10.0):
+    # poll() is non-blocking and does not drive the fusion queue; the
+    # background tick (5 ms) launches the op, so give it wall-clock time.
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if thvd.poll(h):
+            return
+        time.sleep(0.002)
+    raise AssertionError("handle never completed")
+
+
+def test_inplace_poll_then_synchronize_identity(thvd):
+    """synchronize after a poll-side write-back returns the ORIGINAL
+    tensor object (the reference's identity contract,
+    torch/mpi_ops.py:328-344), and repeated poll stays True."""
+    size = thvd.size()
+    t = torch.ones(4)
+    h = thvd.allreduce_async_(t, average=False, name="poll.id")
+    _poll_until_done(thvd, h)
+    assert thvd.poll(h) is True  # idempotent after completion
+    assert torch.equal(t, torch.full((4,), float(size)))  # written back
+    out = thvd.synchronize(h)
+    assert out is t
+
+
+def test_inplace_fire_and_forget_pins_nothing(thvd):
+    """A polled-to-completion in-place handle that is never synchronized
+    releases the underlying handle (jax.Array un-pinned) and its record
+    dies with the target tensor."""
+    import gc
+
+    from horovod_tpu.core import state as _state
+    from horovod_tpu.frontends.torch import _inplace_targets
+
+    mgr = _state.global_state().handle_manager
+    base = mgr.live_count()
+    t = torch.ones(4)
+    h = thvd.allreduce_async_(t, average=False, name="fire.forget")
+    _poll_until_done(thvd, h)
+    assert mgr.live_count() == base  # released on poll, not synchronize
+    assert h in _inplace_targets    # tiny weakref record remains
+    del t
+    gc.collect()
+    assert h not in _inplace_targets  # evicted by the weakref callback
+    with pytest.raises(ValueError, match="garbage-collected|already been"):
+        thvd.synchronize(h)
+
+
+def test_inplace_poll_synchronize_after_target_dropped(thvd):
+    """Target GC'd between poll-completion and synchronize: the result
+    went with the tensor, so synchronize raises a clear error."""
+    import gc
+
+    t = torch.ones(4)
+    h = thvd.allreduce_async_(t, average=False, name="poll.dropped")
+    _poll_until_done(thvd, h)
+    tid = id(t)
+    del t
+    gc.collect()
+    del tid
+    with pytest.raises(ValueError):
+        thvd.synchronize(h)
+
+
 def test_allgather(thvd):
     size = thvd.size()
     t = torch.arange(6).reshape(3, 2)
