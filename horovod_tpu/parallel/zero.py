@@ -26,8 +26,13 @@ Caveat (inherent to ZeRO-1, documented by every implementation): the
 optimizer transformation must be *elementwise* (sgd, momentum, adam,
 adamw, rmsprop, ... — anything that treats each parameter independently).
 Transforms that aggregate across the whole tree (``clip_by_global_norm``)
-would see only the local shard; compose them before
-``make_zero_train_step`` at your own risk or clip per-shard.
+would see only the local shard and silently train wrong.
+:func:`make_zero_train_step` therefore probes the optimizer at build
+time — it applies one update to a small vector and to its two halves
+independently and requires identical results — and raises for
+aggregating chains, naming the alternatives (clip per-element with
+``optax.clip``, clip-then-ZeRO is not recoverable per-shard, or pass
+``validate_elementwise=False`` to accept shard-local semantics).
 
 Usage::
 
@@ -95,6 +100,56 @@ def _flat_shard(tree, n: int):
     return shard, unravel, true_size
 
 
+def _check_elementwise(optimizer) -> None:
+    """Build-time probe for the elementwise-optimizer precondition.
+
+    An elementwise transform updates a concatenated vector exactly as it
+    updates the parts with independent states — which is precisely how
+    ZeRO-1 will run it (each replica updates its shard with its shard of
+    state).  A transform that aggregates across the tree
+    (``clip_by_global_norm``: the norm of a half differs from the norm
+    of the whole) fails the probe and would silently train wrong.
+
+    Probe values are large (~1e4) so norm-dependent transforms with any
+    realistic threshold take their data-dependent branch.  Transforms
+    whose ``update`` needs extra arguments (GradientTransformationExtraArgs)
+    cannot be probed and are skipped with a warning.
+    """
+    import warnings
+
+    import numpy as np
+
+    probe = jnp.asarray(np.linspace(1.0e4, -3.0e4, 16, dtype=np.float32))
+    try:
+        full, _ = optimizer.update(probe, optimizer.init(probe), probe)
+        parts = []
+        for part in (probe[:8], probe[8:]):
+            up, _ = optimizer.update(part, optimizer.init(part), part)
+            parts.append(np.asarray(up))
+        full = np.asarray(full)
+    except TypeError as e:
+        warnings.warn(
+            "make_zero_train_step could not probe the optimizer for the "
+            f"elementwise precondition ({e}); proceeding unchecked — "
+            "ensure no transform aggregates across parameters "
+            "(see horovod_tpu/parallel/zero.py docstring)")
+        return
+    if not np.allclose(full, np.concatenate(parts), rtol=1e-5, atol=1e-5):
+        raise ValueError(
+            "ZeRO-1 requires an ELEMENTWISE optimizer: updating a vector "
+            "must equal updating its parts independently, because each "
+            "replica will only ever see its 1/N shard of the gradients "
+            "and optimizer state.  The given optax chain failed that "
+            "probe — it aggregates across parameters (e.g. "
+            "optax.clip_by_global_norm computes the GLOBAL gradient "
+            "norm, but under ZeRO-1 each replica would clip by its "
+            "shard's norm, silently training wrong).  Alternatives: "
+            "clip per-element with optax.clip(delta); clip by global "
+            "norm OUTSIDE the optimizer on the full gradient before "
+            "ZeRO-1 sees it; or pass validate_elementwise=False to "
+            "accept shard-local semantics.")
+
+
 def make_zero_train_step(
     loss_fn,
     optimizer,
@@ -103,6 +158,7 @@ def make_zero_train_step(
     compression=None,
     donate: bool = True,
     has_state: bool = False,
+    validate_elementwise: bool = True,
 ) -> ZeroTrainStep:
     """Build a ZeRO-1 data-parallel train step over the replica mesh.
 
@@ -137,6 +193,9 @@ def make_zero_train_step(
         if optimizer._compression is not None:
             compression = optimizer._compression
         optimizer = optimizer._inner
+
+    if validate_elementwise:
+        _check_elementwise(optimizer)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=has_state)
 
@@ -201,6 +260,27 @@ def make_zero_train_step(
         if key not in init_cache:
             abstract = jax.eval_shape(
                 optimizer.init, jax.ShapeDtypeStruct((chunk,), dtype))
+            # _state_specs shards every ndim>=1 state leaf over the
+            # replica axis, which is only correct for chunk-sized
+            # per-parameter vectors (momentum/variance slices).  A leaf
+            # of any other shape (an array hyperparameter from
+            # inject_hyperparams, a non-elementwise transform's
+            # aggregate) would get silently wrong sharding — refuse.
+            bad = [tuple(leaf.shape)
+                   for leaf in jax.tree_util.tree_leaves(abstract)
+                   if getattr(leaf, "ndim", 0) >= 1
+                   and tuple(leaf.shape) != (chunk,)]
+            if bad:
+                raise ValueError(
+                    "ZeRO-1 shards every non-scalar optimizer-state "
+                    "leaf over the replica axis, so each such leaf must "
+                    f"be one ({chunk},)-shaped per-parameter slice; the "
+                    f"given optimizer's state has leaves of shape {bad}. "
+                    "This usually means a non-elementwise transform or "
+                    "an array-valued hyperparameter "
+                    "(optax.inject_hyperparams) — keep those outside "
+                    "make_zero_train_step (see parallel/zero.py "
+                    "docstring).")
             init_cache[key] = jax.jit(jax.shard_map(
                 per_replica_init, mesh=mesh,
                 in_specs=(P(),), out_specs=_state_specs(abstract),
@@ -248,7 +328,9 @@ def make_zero_train_step(
 def make_zero_train_step_with_state(loss_fn, optimizer, mesh=None,
                                     average: bool = True,
                                     compression=None,
-                                    donate: bool = True) -> ZeroTrainStep:
+                                    donate: bool = True,
+                                    validate_elementwise: bool = True,
+                                    ) -> ZeroTrainStep:
     """Stateful-model spelling (BatchNorm etc.) of
     :func:`make_zero_train_step` — ``loss_fn(params, state, batch) ->
     (loss, state)``; ``step(params, model_state, opt_state, batch) ->
@@ -256,4 +338,5 @@ def make_zero_train_step_with_state(loss_fn, optimizer, mesh=None,
     :func:`~horovod_tpu.parallel.training.make_train_step_with_state`."""
     return make_zero_train_step(loss_fn, optimizer, mesh=mesh,
                                 average=average, compression=compression,
-                                donate=donate, has_state=True)
+                                donate=donate, has_state=True,
+                                validate_elementwise=validate_elementwise)

@@ -160,3 +160,59 @@ def test_zero_composes_with_compression(hvd):
         assert a.dtype == b.dtype
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-3)
+
+
+def test_zero_rejects_global_norm_clipping(hvd):
+    """clip_by_global_norm aggregates across the whole tree; under ZeRO-1
+    each replica would clip by its shard's norm — the build-time probe
+    must refuse (round-3 verdict item 5)."""
+    model = MnistMLP(hidden=32)
+    opt = optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1))
+    with pytest.raises(ValueError, match="ELEMENTWISE"):
+        make_zero_train_step(_loss_fn(model), opt)
+
+
+def test_zero_elementwise_escape_hatch(hvd):
+    """validate_elementwise=False documents acceptance of shard-local
+    semantics and builds (the documented escape hatch)."""
+    model = MnistMLP(hidden=16)
+    opt = optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1))
+    zstep = make_zero_train_step(_loss_fn(model), opt,
+                                 validate_elementwise=False, donate=False)
+    params = init_params(model)
+    images, labels = synthetic_mnist(32)
+    batch = shard_batch((jnp.asarray(images), jnp.asarray(labels)))
+    p, _, loss = zstep.step(params, zstep.init(params), batch)
+    assert np.isfinite(float(loss))
+
+
+@pytest.mark.parametrize("opt_ctor", [
+    lambda: optax.adamw(1e-3),
+    lambda: optax.chain(optax.clip(1.0), optax.sgd(0.1)),  # per-element
+    lambda: optax.sgd(0.1, momentum=0.9),
+])
+def test_zero_probe_accepts_elementwise_chains(hvd, opt_ctor):
+    """Per-element transforms (including optax.clip, the sanctioned
+    clipping alternative) pass the probe."""
+    model = MnistMLP(hidden=16)
+    zstep = make_zero_train_step(_loss_fn(model), opt_ctor(), donate=False)
+    assert zstep.init is not None
+
+
+def test_zero_rejects_non_chunk_state_leaves(hvd):
+    """A state leaf that is not one (chunk,)-slice per parameter would get
+    silently wrong replica-axis sharding — init must refuse (advisor
+    round-3 item 3)."""
+    model = MnistMLP(hidden=16)
+
+    def bad_init(params):
+        return {"lr_table": jnp.ones((3,), jnp.float32)}
+
+    def bad_update(updates, state, params=None):
+        return jax.tree_util.tree_map(lambda u: -0.1 * u, updates), state
+
+    opt = optax.GradientTransformation(bad_init, bad_update)
+    zstep = make_zero_train_step(_loss_fn(model), opt, donate=False)
+    params = init_params(model)
+    with pytest.raises(ValueError, match="per-parameter slice"):
+        zstep.init(params)
