@@ -55,6 +55,16 @@ struct Pending {
   double first_seen = 0;
 };
 
+// Shared ERROR text for an abandoned collective — byte-identical with
+// ops/coordinator.py::_withdraw_message (parity fuzz-tested).
+std::string WithdrawMessage(const std::string& name, int32_t rank) {
+  std::ostringstream os;
+  os << "Collective " << name << " was abandoned: rank " << rank
+     << " timed out waiting for the remaining ranks; the operation fails"
+     << " on all ranks.";
+  return os.str();
+}
+
 class Coordinator {
  public:
   Coordinator(int size, int64_t fusion_threshold)
@@ -216,6 +226,22 @@ class Coordinator {
     return resp;
   }
 
+  // Round 4; no reference equivalent — the reference can only hang when
+  // a rank gives up (operations.cc:1290-1326).  Drops the pending entry
+  // and queues an ERROR response so every rank fails the op promptly.
+  // No-op when negotiation already completed (the op is about to finish).
+  void Withdraw(const std::string& name, int32_t rank) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (std::find(ready_.begin(), ready_.end(), name) != ready_.end())
+      return;
+    table_.erase(name);
+    Response resp;
+    resp.response_type = ResponseType::kError;
+    resp.tensor_names.push_back(name);
+    resp.error_message = WithdrawMessage(name, rank);
+    withdrawn_.push_back(std::move(resp));
+  }
+
   // ≙ the response fusion loop (operations.cc:1328-1374): same-device,
   // same-dtype ALLREDUCE responses merge under the byte threshold.
   // `sizes` maps tensor name → payload bytes of one replica's tensor.
@@ -224,7 +250,8 @@ class Coordinator {
     std::vector<Response> responses;
     for (const auto& n : ready_) responses.push_back(ConstructResponse(n));
     ready_.clear();
-    std::vector<Response> fused;
+    std::vector<Response> fused = std::move(withdrawn_);
+    withdrawn_.clear();
     for (size_t i = 0; i < responses.size(); ++i) {
       Response r = std::move(responses[i]);
       if (r.response_type != ResponseType::kAllreduce) {
@@ -306,6 +333,7 @@ class Coordinator {
   std::mutex mu_;
   std::map<std::string, Pending> table_;
   std::vector<std::string> ready_;
+  std::vector<Response> withdrawn_;
   std::unordered_map<std::string, DataType> dtype_by_name_;
   std::string out_buffer_;
 };
@@ -368,6 +396,11 @@ int hvd_coord_poll_responses(void* c, const char* sizes_buf, int sizes_len,
 int hvd_coord_fetch_responses(void* c, char* out, int cap) {
   return static_cast<int>(
       static_cast<hvdtpu::Coordinator*>(c)->FetchResponses(out, cap));
+}
+
+void hvd_coord_withdraw(void* c, const char* name, int len, int rank) {
+  static_cast<hvdtpu::Coordinator*>(c)->Withdraw(std::string(name, len),
+                                                 rank);
 }
 
 int hvd_coord_check_stalled(void* c, double threshold, char* out, int cap) {

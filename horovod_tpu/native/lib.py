@@ -110,6 +110,9 @@ def _load() -> None:
     _lib.hvd_coord_check_stalled.argtypes = [
         ctypes.c_void_p, ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
     _lib.hvd_coord_check_stalled.restype = ctypes.c_int
+    if hasattr(_lib, "hvd_coord_withdraw"):  # absent in a stale prebuilt
+        _lib.hvd_coord_withdraw.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
 
     _lib.hvd_timeline_create.argtypes = [ctypes.c_char_p]
     _lib.hvd_timeline_create.restype = ctypes.c_void_p

@@ -628,11 +628,13 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
     _, ks = _mp_kernels()
 
     if not ops:
-        # The local op was withdrawn (synchronize timeout / shutdown
-        # poisoning): skip this response rather than crash mid-list.  The
-        # peers executing it will block on this rank and eventually hit
-        # their own timeouts — the withdrawal already broke SPMD for this
-        # op.
+        # The local op is gone (shutdown poisoning, or the local-fallback
+        # withdrawal after the controller never answered a WITHDRAW
+        # frame): skip this response rather than crash mid-list.  In the
+        # normal timeout path this cannot happen anymore — a timed-out
+        # rank withdraws through the coordinator, which broadcasts an
+        # ERROR response (handled above) instead of ever constructing a
+        # collective response missing a participant.
         return
 
     if resp.response_type == ResponseType.ALLREDUCE:
@@ -864,8 +866,28 @@ def synchronize(handle: int):
                 _drain()
                 _time.sleep(0.001)
             if h.result is None:
-                # Withdraw the op locally so the name can be reused and the
-                # handle doesn't pin the contribution forever.
+                # Withdraw GROUP-WIDE (round 4): tell the coordinator we
+                # gave up so it broadcasts an ERROR response and every
+                # rank fails this op within the grace window — instead of
+                # each peer serially eating its own full timeout, or (the
+                # SPMD hazard) this rank later skipping a broadcast
+                # response its peers execute and block on.
+                try:
+                    if st.process_index == 0:
+                        st.coordinator.withdraw(h.name, 0)
+                    else:
+                        st.transport.withdraw(h.name)
+                except (OSError, AttributeError):
+                    pass  # controller unreachable: fall back to local
+                grace_dl = _time.monotonic() + float(_os.environ.get(
+                    "HOROVOD_TPU_WITHDRAW_GRACE", "10"))
+                while h.result is None and _time.monotonic() < grace_dl:
+                    _drain()
+                    _time.sleep(0.001)
+            if h.result is None:
+                # Controller never answered the withdrawal: error locally
+                # so the name can be reused and the handle doesn't pin
+                # the contribution forever.
                 _queue.take([h.name])
                 h.result = HorovodError(
                     f"Collective {h.name} timed out after {timeout:.0f}s "

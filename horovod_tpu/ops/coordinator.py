@@ -56,6 +56,15 @@ class _PendingTensor:
     first_seen: float = 0.0
 
 
+def _withdraw_message(name: str, rank: int) -> str:
+    """Shared ERROR text for an abandoned collective — must stay
+    byte-identical with native/coordinator.cc's WithdrawMessage (the
+    parity fuzz test compares packed responses)."""
+    return (f"Collective {name} was abandoned: rank {rank} timed out "
+            f"waiting for the remaining ranks; the operation fails on "
+            f"all ranks.")
+
+
 class PyCoordinator:
     """Pure-Python coordinator (executable spec for native/coordinator.cc).
 
@@ -74,7 +83,26 @@ class PyCoordinator:
         # (the reference reads this from its TensorTable during the fusion
         # loop, operations.cc:1328-1374).
         self._resp_dtype: Dict[str, DataType] = {}
+        # ERROR responses queued by withdraw(); drained ahead of the ready
+        # tensors by poll_responses.
+        self._withdrawn: List[Response] = []
         self.shutdown = False
+
+    # -- withdraw (round 4; no reference equivalent — the reference can
+    # -- only hang when a rank gives up, operations.cc:1290-1326) ---------
+    def withdraw(self, name: str, rank: int) -> None:
+        """A rank abandoned ``name`` (synchronize timeout): drop the
+        pending entry and queue an ERROR response for every rank, so the
+        whole group fails the op promptly instead of each peer serially
+        eating its own timeout.  No-op when negotiation already completed
+        (the op is about to finish normally — let it)."""
+        with self._lock:
+            if name in self.ready:
+                return
+            self.table.pop(name, None)
+            self._withdrawn.append(Response(
+                ResponseType.ERROR, [name],
+                error_message=_withdraw_message(name, rank)))
 
     # -- IncrementTensorCount (operations.cc:222-247) ----------------------
     def submit(self, req: Request, now: Optional[float] = None) -> bool:
@@ -213,9 +241,10 @@ class PyCoordinator:
         ``TensorFusionThresholdBytes`` accounting.
         """
         with self._lock:
+            withdrawn, self._withdrawn = self._withdrawn, []
             ready, self.ready = self.ready, []
             responses = [self._construct_response_locked(n) for n in ready]
-        fused: List[Response] = []
+        fused: List[Response] = list(withdrawn)
         i = 0
         while i < len(responses):
             r = responses[i]
@@ -295,6 +324,10 @@ class NativeCoordinator:
                 f"ops/wire.py and native/wire.cc?).")
         return bool(rc)
 
+    def withdraw(self, name: str, rank: int) -> None:
+        nb = name.encode("utf-8")
+        self._lib.hvd_coord_withdraw(self._ptr, nb, len(nb), rank)
+
     def poll_responses(self, sizes_bytes: Dict[str, int]) -> List[Response]:
         import ctypes
         # Ship the payload sizes as a serialized side table.
@@ -339,7 +372,9 @@ class Coordinator:
     def __init__(self, size: int, fusion_threshold: int, timeline=None):
         self.timeline = timeline
         self._last_stall_check = time.monotonic()
-        if _native.NATIVE and hasattr(_native.raw(), "hvd_coord_fetch_responses"):
+        # Gate on the newest symbol so a stale prebuilt .so falls back to
+        # the Python twin instead of AttributeError-ing at call time.
+        if _native.NATIVE and hasattr(_native.raw(), "hvd_coord_withdraw"):
             self._impl = NativeCoordinator(size, fusion_threshold)
         else:
             self._impl = PyCoordinator(size, fusion_threshold)
@@ -354,6 +389,9 @@ class Coordinator:
         if done and self.timeline is not None:
             self.timeline.negotiate_end(req.tensor_name)
         return done
+
+    def withdraw(self, name: str, rank: int) -> None:
+        self._impl.withdraw(name, rank)
 
     def poll_responses(self, sizes_bytes: Dict[str, int]) -> List[Response]:
         now = time.monotonic()

@@ -39,6 +39,9 @@ FRAME_RESPONSES = 2   # controller→worker: packed response list
 FRAME_TOPO = 3        # controller→worker: <iiii> local_rank local_size
                       #                           cross_rank cross_size
 FRAME_SHUTDOWN = 4    # either direction: cooperative shutdown
+FRAME_WITHDRAW = 5    # worker→controller: <i rank><H len><name> — the
+                      # rank's synchronize timed out on <name>; the
+                      # coordinator fails the op for the whole group
 
 _HDR = struct.Struct("<IB")
 
@@ -194,6 +197,13 @@ class ControllerTransport:
                     pass
             elif ftype == FRAME_SHUTDOWN:
                 self.shutdown_requested.set()
+            elif ftype == FRAME_WITHDRAW:
+                (wrank,) = struct.unpack_from("<i", payload)
+                (nlen,) = struct.unpack_from("<H", payload, 4)
+                name = payload[6:6 + nlen].decode("utf-8")
+                # The next drain tick broadcasts the resulting ERROR
+                # response to every rank (including the withdrawer).
+                self.coordinator.withdraw(name, wrank)
 
     # -- controller-side API used by the drain loop ------------------------
     def submit(self, req: Request) -> None:
@@ -332,6 +342,15 @@ class WorkerTransport:
     def request_shutdown(self) -> None:
         with self._send_lock:
             _send_frame(self._sock, FRAME_SHUTDOWN)
+
+    def withdraw(self, name: str) -> None:
+        """Tell the controller this rank gave up waiting on ``name`` (its
+        synchronize timed out); the coordinator fails the op group-wide."""
+        nb = name.encode("utf-8")
+        with self._send_lock:
+            _send_frame(self._sock, FRAME_WITHDRAW,
+                        struct.pack("<i", self.rank)
+                        + struct.pack("<H", len(nb)) + nb)
 
     def poll_responses(self) -> Optional[List[Response]]:
         """Next broadcast response list, or None if nothing arrived."""

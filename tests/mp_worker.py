@@ -312,6 +312,58 @@ def scenario_clean_exit(hvd):
         print("CLEANEXIT_OK rank=0")
 
 
+def scenario_withdraw(hvd):
+    """A rank whose synchronize times out WITHDRAWS the op group-wide:
+    the coordinator broadcasts an ERROR response and the op fails on
+    every rank within the grace window — instead of the round-3 behavior
+    (local-only withdrawal; peers later execute a response the withdrawer
+    skips, or serially eat their own 300 s timeouts).  The failure is
+    surgical: the group survives and later collectives work."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import HorovodError
+
+    rank = hvd.rank()
+    assert float(os.environ["HOROVOD_TPU_SYNC_TIMEOUT"]) <= 3.0
+
+    # Leg 1 — a WORKER (rank 1) gives up: the WITHDRAW frame rides the
+    # TCP control plane to the coordinator.
+    t0 = time.monotonic()
+    if rank == 1:
+        h = hvd.allreduce_async(jnp.ones((2,)), name="abandoned.w",
+                                average=False)
+        try:
+            hvd.synchronize(h)
+            raise AssertionError("expected the withdrawal error")
+        except HorovodError as e:
+            # The coordinator's message (not the local-fallback timeout
+            # text) proves the ERROR round trip happened.
+            assert "was abandoned: rank 1" in str(e), str(e)
+        assert time.monotonic() - t0 < 20.0, "fail-fast regressed"
+    else:
+        time.sleep(4.0)  # outlive the peer's timeout; never submit
+    out = hvd.allreduce(jnp.ones((2,)), name="recover.w", average=False)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    # Leg 2 — the CONTROLLER (rank 0) gives up: withdrawal goes straight
+    # into the in-process coordinator, ERROR still broadcasts to all.
+    t1 = time.monotonic()
+    if rank == 0:
+        h = hvd.allreduce_async(jnp.ones((2,)), name="abandoned.c",
+                                average=False)
+        try:
+            hvd.synchronize(h)
+            raise AssertionError("expected the withdrawal error")
+        except HorovodError as e:
+            assert "was abandoned: rank 0" in str(e), str(e)
+        assert time.monotonic() - t1 < 20.0, "fail-fast regressed"
+    else:
+        time.sleep(4.0)
+    out = hvd.allreduce(jnp.ones((2,)), name="recover.c", average=False)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    print(f"WITHDRAW_OK rank={rank}")
+
+
 def scenario_checkpoint(hvd):
     import jax.numpy as jnp
 

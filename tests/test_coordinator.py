@@ -223,3 +223,49 @@ def test_wire_uint32_uint64_roundtrip(make_coord):
     assert resps[0].response_type == ResponseType.ERROR
     assert "uint32" in resps[0].error_message
     assert "uint64" in resps[0].error_message
+
+
+def test_withdraw_errors_pending_op(make_coord):
+    """withdraw() (round 4) drops the pending entry and queues an ERROR
+    response so every rank fails the op promptly — the reference could
+    only hang when a rank gave up (operations.cc:1290-1326)."""
+    c = make_coord(2, 1 << 20)
+    assert c.submit(_req(0, "w.op")) is False
+    c.withdraw("w.op", 0)
+    resps = c.poll_responses({"w.op": 16})
+    assert len(resps) == 1
+    assert resps[0].response_type == ResponseType.ERROR
+    assert resps[0].tensor_names == ["w.op"]
+    assert "was abandoned: rank 0" in resps[0].error_message
+    # Entry gone: the name is reusable; a late peer submit starts a
+    # FRESH negotiation instead of corrupting the withdrawn one.
+    assert c.submit(_req(1, "w.op")) is False
+
+
+def test_withdraw_after_ready_is_noop(make_coord):
+    """A withdrawal racing negotiation completion loses: the op is about
+    to finish normally, so it does."""
+    c = make_coord(2, 1 << 20)
+    c.submit(_req(0, "done.op"))
+    assert c.submit(_req(1, "done.op")) is True
+    c.withdraw("done.op", 1)
+    resps = c.poll_responses({"done.op": 16})
+    assert len(resps) == 1
+    assert resps[0].response_type == ResponseType.ALLREDUCE
+
+
+def test_withdraw_packed_response_parity():
+    """The withdrawal ERROR must pack byte-identically from both
+    coordinator implementations (shared wire contract)."""
+    if not (_native_lib.NATIVE
+            and hasattr(_native_lib.raw(), "hvd_coord_withdraw")):
+        pytest.skip("native library not built")
+    py, nat = PyCoordinator(2, 1 << 20), NativeCoordinator(2, 1 << 20)
+    try:
+        for c in (py, nat):
+            c.submit(_req(0, "p.op"))
+            c.withdraw("p.op", 0)
+        assert pack_response_list(py.poll_responses({"p.op": 16})) == \
+            pack_response_list(nat.poll_responses({"p.op": 16}))
+    finally:
+        nat.close()

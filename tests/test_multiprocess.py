@@ -137,6 +137,26 @@ def test_clean_exit_without_shutdown_is_cooperative():
 
 
 @pytest.mark.slow
+def test_withdraw_fails_group_fast_and_group_survives():
+    # Round-4 verdict item 4: a synchronize timeout on one rank must fail
+    # the op on EVERY rank within seconds (WITHDRAW frame -> coordinator
+    # ERROR broadcast), and must not poison the group — both legs
+    # (worker-initiated and controller-initiated) plus recovery
+    # collectives run inside one launch.
+    import time as _time
+
+    t0 = _time.monotonic()
+    out = _launch("withdraw",
+                  extra_env={"HOROVOD_TPU_SYNC_TIMEOUT": "2",
+                             "HOROVOD_TPU_WITHDRAW_GRACE": "10"},
+                  timeout=180.0)
+    assert "WITHDRAW_OK rank=0" in out
+    assert "WITHDRAW_OK rank=1" in out
+    # Well under one serial 300s timeout, let alone two.
+    assert _time.monotonic() - t0 < 120.0
+
+
+@pytest.mark.slow
 def test_two_process_checkpoint_restore_and_resume(tmp_path):
     out = _launch("checkpoint",
                   extra_env={"HVD_TPU_TEST_CKPT": str(tmp_path / "ck.msgpack")})
