@@ -16,13 +16,25 @@ frontend rides), plus:
   tensorflow/__init__.py:135-192): gradients are allreduced as they come
   out of ``tape.gradient``.
 * :func:`DistributedOptimizer` — wraps a ``tf.keras`` optimizer so
-  ``apply_gradients`` reduces first (eager only; inside ``tf.function``
-  the cross-process queue cannot run — use DistributedGradientTape
-  outside the compiled region or the JAX surface for compiled training).
+  ``apply_gradients`` reduces first.
 * :func:`broadcast_variables` / :func:`broadcast_global_variables` — the
   consistent-initialization broadcast (reference
   BroadcastGlobalVariablesHook, tensorflow/__init__.py:100-130; TF2 has
   no sessions, so this is a direct call).
+
+Compiled graphs (round 4): collectives now also work INSIDE
+``tf.function`` — the graph-mode analogue of the reference's
+``AsyncOpKernel`` enqueue-from-graph-execution
+(reference horovod/tensorflow/mpi_ops.cc:270-298).  During tracing each
+collective becomes one ``tf.py_function`` node whose body re-enters the
+eager queue path at graph-execution time with concrete tensors, so
+``fn = tf.function(train_step); fn(batch)`` negotiates and reduces
+mid-graph exactly like ``session.run(train_op)`` did in the reference.
+Collective names are captured at trace time (one stable name per graph
+node, like the reference's TF op names), so repeated executions reuse
+the negotiation slot; the py_function boundary keeps the cross-process
+queue OUT of the compiled cluster, which is what makes this sound — the
+collective is a host callback, not a TF op XLA would try to compile.
 
 TPU note: TF does not drive the TPU here — JAX/XLA does.  This frontend
 exists so TF-based data/eval pipelines and models can participate in the
@@ -60,12 +72,37 @@ def _to_numpy(t) -> np.ndarray:
     if hasattr(t, "numpy"):
         try:
             return t.numpy()
-        except Exception as e:  # symbolic tensor inside tf.function
+        except Exception as e:  # symbolic tensor outside our graph bridge
             raise RuntimeError(
-                "horovod_tpu.frontends.tensorflow collectives run eagerly; "
-                "call them outside tf.function (or use the JAX surface for "
-                "compiled training).") from e
+                "horovod_tpu.frontends.tensorflow got a symbolic tensor "
+                "on the eager path; inside tf.function the collectives "
+                "bridge through tf.py_function automatically — pass the "
+                "tf.Tensor itself, not a structure the bridge cannot "
+                "see.") from e
     return np.asarray(t)
+
+
+def _tracing() -> bool:
+    """True while tf.function traces the caller (graph construction) —
+    the moment to plant a ``tf.py_function`` bridge node instead of
+    touching tensor values.  Inside the py_function body eager execution
+    is back on, so the bridge cannot recurse."""
+    tf = _tf()
+    try:
+        return not tf.executing_eagerly()
+    except Exception:
+        return False
+
+
+def _graph_bridge(eager_fn, inputs, out_dtypes, op_name: str):
+    """One ``tf.py_function`` node calling ``eager_fn`` with concrete
+    tensors at graph-execution time (≙ the reference's AsyncOpKernel
+    enqueue from inside the execution engine, mpi_ops.cc:270-298)."""
+    tf = _tf()
+    flat = tf.py_function(func=eager_fn, inp=list(inputs),
+                          Tout=list(out_dtypes),
+                          name=op_name.replace(".", "_"))
+    return flat if isinstance(flat, (list, tuple)) else [flat]
 
 
 def _wrap(out, like: np.ndarray):
@@ -74,6 +111,54 @@ def _wrap(out, like: np.ndarray):
     Torch frontend does, torch.py:66-67)."""
     tf = _tf()
     return tf.constant(np.asarray(out).astype(like.dtype, copy=False))
+
+
+def _allreduce_in_graph(tensor, average: bool, name: Optional[str],
+                        compression):
+    """tf.function branch of :func:`allreduce`: one py_function node per
+    collective, name fixed at trace time (≙ the reference's per-TF-op
+    names, mpi_ops.cc:270-298)."""
+    tf = _tf()
+    if isinstance(tensor, tf.IndexedSlices):
+        op_name = name or _C._auto_name("allreduce.tf.fn.sparse")
+        vdt, idt = tensor.values.dtype, tensor.indices.dtype
+
+        def _eager(values, indices):
+            red = _S.allreduce(
+                _S.IndexedSlices(values=values.numpy(),
+                                 indices=indices.numpy(), dense_shape=()),
+                average=average, name=op_name)
+            return (np.asarray(red.values).astype(vdt.as_numpy_dtype,
+                                                  copy=False),
+                    np.asarray(red.indices).astype(idt.as_numpy_dtype,
+                                                   copy=False))
+
+        vals, idxs = _graph_bridge(_eager,
+                                   [tensor.values, tensor.indices],
+                                   [vdt, idt], op_name)
+        # The gathered row count is data-dependent (it sums every rank's
+        # slice count) — only the trailing dims are static.
+        vals.set_shape([None] + list(tensor.values.shape[1:]))
+        idxs.set_shape([None])
+        return tf.IndexedSlices(vals, idxs,
+                                dense_shape=tensor.dense_shape)
+
+    op_name = name or _C._auto_name("allreduce.tf.fn")
+    dt = tensor.dtype
+
+    def _eager(t):
+        arr = t.numpy()
+        if compression is None:
+            out = _C.allreduce(arr, average=average, name=op_name)
+        else:
+            wire, ctx = compression.compress(arr)
+            out = compression.decompress(
+                _C.allreduce(wire, average=average, name=op_name), ctx)
+        return np.asarray(out).astype(dt.as_numpy_dtype, copy=False)
+
+    (out,) = _graph_bridge(_eager, [tensor], [dt], op_name)
+    out.set_shape(tensor.shape)
+    return out
 
 
 def allreduce(tensor, average: bool = True, name: Optional[str] = None,
@@ -85,8 +170,14 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
     they already ship a minimal payload, so ``compression`` (the dense
     wire cast, ``hvd.Compression.fp16``/``bf16``) applies to dense
     tensors only.
+
+    Inside ``tf.function`` the collective becomes a ``tf.py_function``
+    bridge node executing the same eager queue path mid-graph (see the
+    module docstring).
     """
     tf = _tf()
+    if _tracing():
+        return _allreduce_in_graph(tensor, average, name, compression)
     if isinstance(tensor, tf.IndexedSlices):
         # dense_shape may legally be None; the exchange never needs it
         # (it only gathers values + indices, like the reference).
@@ -111,11 +202,38 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
 
 
 def allgather(tensor, name: Optional[str] = None):
+    if _tracing():
+        op_name = name or _C._auto_name("allgather.tf.fn")
+        dt = tensor.dtype
+
+        def _eager(t):
+            arr = t.numpy()
+            return np.asarray(_C.allgather(arr, name=op_name)).astype(
+                dt.as_numpy_dtype, copy=False)
+
+        (out,) = _graph_bridge(_eager, [tensor], [dt], op_name)
+        # Ragged gather: dim 0 sums every rank's (possibly different)
+        # extent — static only in the trailing dims.
+        out.set_shape([None] + list(tensor.shape[1:]))
+        return out
     arr = _to_numpy(tensor)
     return _wrap(_C.allgather(arr, name=name), arr)
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    if _tracing():
+        op_name = name or _C._auto_name("broadcast.tf.fn")
+        dt = tensor.dtype
+
+        def _eager(t):
+            arr = t.numpy()
+            return np.asarray(
+                _C.broadcast(arr, root_rank, name=op_name)).astype(
+                    dt.as_numpy_dtype, copy=False)
+
+        (out,) = _graph_bridge(_eager, [tensor], [dt], op_name)
+        out.set_shape(tensor.shape)
+        return out
     arr = _to_numpy(tensor)
     return _wrap(_C.broadcast(arr, root_rank, name=name), arr)
 
@@ -177,7 +295,28 @@ def _allreduce_batch(tensors, average: bool, prefix: str,
     tensor fusion batches the small gradients into one collective
     (ops/collective.py fused buckets) instead of N round trips.
     ``compression`` casts the wire payload down; ``_wrap`` restores each
-    gradient's original dtype on the way out."""
+    gradient's original dtype on the way out.
+
+    Inside ``tf.function`` the WHOLE batch becomes one py_function node
+    whose body re-runs this function eagerly — preserving the
+    async+fusion behavior mid-graph (the reference's graph path equally
+    fused through its per-op kernels + fusion buffer)."""
+    if _tracing():
+        tf = _tf()
+        idx = [i for i, t in enumerate(tensors) if t is not None]
+        base = _C._auto_name(f"{prefix}.fn")
+
+        def _eager(*concrete):
+            return _allreduce_batch(list(concrete), average, base,
+                                    compression)
+
+        outs = _graph_bridge(_eager, [tensors[i] for i in idx],
+                             [tensors[i].dtype for i in idx], base)
+        result: List[Any] = [None] * len(tensors)
+        for o, i in zip(outs, idx):
+            o.set_shape(tensors[i].shape)
+            result[i] = o
+        return result
     comp = compression
     arrs = [None if t is None else _to_numpy(t) for t in tensors]
     handles, ctxs = [], []

@@ -312,6 +312,45 @@ def scenario_clean_exit(hvd):
         print("CLEANEXIT_OK rank=0")
 
 
+def scenario_tf_function(hvd):
+    """Compiled-graph collectives across REAL processes (round 4): a
+    tf.function-compiled step allreduces mid-graph through the
+    py_function bridge — the TF2 spelling of the reference's
+    session.run(train_op) with AsyncOpKernels enqueueing from graph
+    execution (mpi_ops.cc:270-298)."""
+    import tensorflow as tf
+
+    import horovod_tpu.frontends.tensorflow as hvdtf
+
+    rank = hvd.rank()
+
+    @tf.function
+    def f(x):
+        return hvdtf.allreduce(x, average=False, name="tffn.op")
+
+    for i in range(3):  # repeated executions reuse the trace-time name
+        out = f(tf.constant([float(rank + 1 + i)]))
+        np.testing.assert_allclose(out.numpy(), [3.0 + 2.0 * i])
+
+    w = tf.Variable([0.0])
+
+    @tf.function
+    def train_step():
+        with hvdtf.DistributedGradientTape(tf.GradientTape()) as tape:
+            # Rank-dependent loss: grad_r = 2*(w - (r+1)); averaged over
+            # the 2 ranks: 2*(w - 1.5) — the compiled update must use
+            # the REDUCED gradient identically on both ranks.
+            loss = (w[0] - float(rank + 1)) ** 2
+        (g,) = tape.gradient(loss, [w])
+        w.assign_sub(0.25 * g)
+        return loss
+
+    for _ in range(25):
+        train_step()
+    np.testing.assert_allclose(w.numpy(), [1.5], atol=1e-3)
+    print(f"TFFN_OK rank={rank}")
+
+
 def scenario_withdraw(hvd):
     """A rank whose synchronize times out WITHDRAWS the op group-wide:
     the coordinator broadcasts an ERROR response and the op fails on

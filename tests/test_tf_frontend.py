@@ -70,13 +70,62 @@ def test_distributed_optimizer_applies_reduced_grads(hvd):
     np.testing.assert_allclose(v.numpy(), [-1.0, -2.0], rtol=1e-6)
 
 
-def test_collectives_inside_tf_function_raise(hvd):
+def test_collectives_inside_tf_function(hvd):
+    """Round 4: collectives work INSIDE tf.function via the py_function
+    bridge (≙ the reference's AsyncOpKernel enqueue from graph
+    execution, mpi_ops.cc:270-298).  Repeated executions of the same
+    compiled function reuse the trace-time collective name."""
     @tf.function
     def f(x):
-        return hvdtf.allreduce(x)
+        return (hvdtf.allreduce(x, average=False),
+                hvdtf.allgather(x),
+                hvdtf.broadcast(x, root_rank=0))
 
-    with pytest.raises(Exception, match="eagerly|numpy"):
-        f(tf.constant([1.0]))
+    for _ in range(3):  # name reuse across executions
+        red, gat, bc = f(tf.constant([1.0, 2.0]))
+        np.testing.assert_allclose(red.numpy(),
+                                   np.array([1.0, 2.0]) * hvd.size())
+        assert gat.shape == (2 * hvd.size(),)
+        np.testing.assert_allclose(bc.numpy(), [1.0, 2.0])
+
+
+def test_indexed_slices_inside_tf_function(hvd):
+    @tf.function
+    def f(values, indices):
+        sl = tf.IndexedSlices(values=values, indices=indices)
+        out = hvdtf.allreduce(sl, average=False)
+        return out.values, out.indices
+
+    vals, idxs = f(tf.constant([[1.0, 2.0]]),
+                   tf.constant([3], dtype="int64"))
+    assert vals.shape[0] == hvd.size()
+    assert idxs.dtype == tf.int64
+    np.testing.assert_allclose(vals.numpy()[0], [1.0, 2.0])
+
+
+def test_compiled_train_step_through_frontend(hvd):
+    """The round-4 verdict's done-condition: a small tf.function-compiled
+    train step whose gradients reduce through the frontend mid-graph —
+    loss must decrease (graph-mode DistributedGradientTape ≙ the
+    reference's session.run(train_op) flow)."""
+    w = tf.Variable([0.0, 0.0])
+    x = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    y = tf.constant([5.0, 6.0])
+
+    @tf.function
+    def train_step():
+        with hvdtf.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = tf.reduce_mean(
+                (tf.linalg.matvec(x, w) - y) ** 2)
+        (g,) = tape.gradient(loss, [w])
+        # Pure-TF SGD update (under KERAS_BACKEND=jax, tf.keras
+        # optimizers are Keras-3/JAX objects that cannot consume
+        # symbolic tf tensors — the graph-mode update is TF's own).
+        w.assign_sub(0.05 * g)
+        return loss
+
+    losses = [float(train_step()) for _ in range(20)]
+    assert losses[-1] < 0.2 * losses[0], losses
 
 
 def test_dtype_preserved_float64_int64(hvd):
