@@ -114,9 +114,38 @@ def make_mesh(config: Optional[ParallelConfig] = None,
     return jax.sharding.Mesh(arr, names)
 
 
+def _hybrid_layout(devs, slice_of, names, sizes, dcn_factor) -> np.ndarray:
+    """Explicit hybrid device layout: outer (DCN) blocks of each split
+    axis cross slices, inner (ICI) blocks stay inside one slice — the
+    same placement contract ``mesh_utils.create_hybrid_device_mesh``
+    implements from hardware attributes, but computed from a declared
+    slice assignment so it works with ANY devices (CPU test meshes,
+    overridden topologies)."""
+    groups: dict = {}
+    for d in devs:
+        groups.setdefault(slice_of(d), []).append(d)
+    slice_ids = sorted(groups)
+    if len({len(g) for g in groups.values()}) != 1:
+        raise ValueError(
+            f"slices must be equal-sized; got "
+            f"{ {s: len(g) for s, g in groups.items()} }")
+    shape = tuple(sizes[a] for a in names)
+    ici_shape = [sizes[a] // dcn_factor.get(a, 1) for a in names]
+    dcn_shape = [dcn_factor.get(a, 1) for a in names]
+    arr = np.empty(shape, dtype=object)
+    for idx in np.ndindex(shape):
+        dcn_coord = [i // m for i, m in zip(idx, ici_shape)]
+        ici_coord = [i % m for i, m in zip(idx, ici_shape)]
+        sid = int(np.ravel_multi_index(dcn_coord, dcn_shape))
+        wid = int(np.ravel_multi_index(ici_coord, ici_shape))
+        arr[idx] = groups[slice_ids[sid]][wid]
+    return arr
+
+
 def make_hybrid_mesh(config: Optional[ParallelConfig] = None,
                      devices: Optional[Sequence] = None,
                      dcn_axes: Tuple[str, ...] = (DATA_AXIS,),
+                     slice_map=None,
                      **degrees) -> jax.sharding.Mesh:
     """Build a mesh for a multi-slice (DCN-connected) TPU deployment.
 
@@ -134,12 +163,23 @@ def make_hybrid_mesh(config: Optional[ParallelConfig] = None,
     between DCN and ICI — e.g. 2 slices x 4 chips with ``data=4, model=2``
     puts a 2-way data factor across DCN and a 2-way data factor on ICI
     inside each slice (the standard multi-slice DP recipe).
+
+    ``slice_map`` overrides slice detection: a callable ``device →
+    slice id`` or a ``device.id → slice id`` mapping.  Use it when the
+    runtime misreports the topology — or to exercise the hybrid layout
+    end-to-end on hardware without slices (the test suite trains over
+    8 CPU devices declared as 2 virtual slices).
     """
     import math
 
     config, devs = _resolve(config, devices, degrees)
 
-    num_slices = len({getattr(d, "slice_index", 0) for d in devs})
+    if slice_map is not None:
+        slice_of = slice_map if callable(slice_map) \
+            else (lambda d: slice_map[d.id])
+    else:
+        slice_of = lambda d: getattr(d, "slice_index", 0)  # noqa: E731
+    num_slices = len({slice_of(d) for d in devs})
     if num_slices <= 1:
         return make_mesh(config, devices=devs)
 
@@ -161,6 +201,9 @@ def make_hybrid_mesh(config: Optional[ParallelConfig] = None,
             f"DCN axes {dcn_axes} with degrees "
             f"{[sizes[a] for a in dcn_axes]} cannot tile {num_slices} "
             f"slices; the cross-slice axes must tile the slices exactly.")
+    if slice_map is not None:
+        arr = _hybrid_layout(devs, slice_of, names, sizes, dcn_factor)
+        return jax.sharding.Mesh(arr, names)
     from jax.experimental import mesh_utils
 
     mesh_shape = [sizes[a] // dcn_factor.get(a, 1) for a in names]
