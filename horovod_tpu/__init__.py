@@ -47,6 +47,7 @@ from .ops.collective import (  # noqa: F401
     broadcast_async,
     grouped_allreduce,
     grouped_allreduce_async,
+    join,
     poll,
     shard,
     synchronize,

@@ -99,6 +99,12 @@ class _GlobalState:
     # background coordinator thread, operations.cc:1167).
     bg_thread: Any = None
     bg_stop: Any = None
+    # hvd.join() state (post-v0.13 uneven-workload barrier): while
+    # ``joining``, this process executes peers' collective responses with
+    # zero contributions; ``join_result`` is set by the JOIN release
+    # response (the last joining rank).
+    joining: bool = False
+    join_result: Optional[int] = None
     lock: threading.RLock = field(default_factory=threading.RLock)
 
 

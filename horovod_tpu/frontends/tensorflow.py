@@ -54,6 +54,7 @@ from ..core.state import (cross_rank, cross_size, init,  # noqa: F401
                           mpi_threads_supported, rank, shutdown, size)
 from ..ops import collective as _C
 from ..ops import sparse as _S
+from ..ops.collective import join  # noqa: F401  (hvd.join barrier)
 from ..ops.compression import Compression  # noqa: F401  (hvd.Compression)
 from ..ops.objects import (allgather_object,  # noqa: F401  (object API)
                            broadcast_object)

@@ -43,6 +43,7 @@ from ..core import state as _state
 from ..core.state import (init, is_initialized, local_rank, local_size,  # noqa: F401
                           mpi_threads_supported, rank, shutdown, size)
 from ..ops import collective as _C
+from ..ops.collective import join  # noqa: F401  (hvd.join barrier)
 from ..ops.compression import Compression  # noqa: F401  (hvd.Compression)
 from ..ops.objects import (allgather_object,  # noqa: F401  (object API)
                            broadcast_object)

@@ -45,6 +45,7 @@ const char* OpName(RequestType t) {
     case RequestType::kAllreduce: return "allreduce";
     case RequestType::kAllgather: return "allgather";
     case RequestType::kBroadcast: return "broadcast";
+    case RequestType::kJoin: return "join";
   }
   return "?";
 }
@@ -72,18 +73,45 @@ class Coordinator {
 
   // ≙ IncrementTensorCount (operations.cc:222-247).
   // Returns 1 when all replicas reported, 0 pending, -1 duplicate rank.
+  // Joined ranks (hvd.join) count as ready for every tensor; the last
+  // JOIN queues the release response (after this batch's data).
   int Submit(const Request& req) {
     std::lock_guard<std::mutex> g(mu_);
+    if (req.request_type == RequestType::kJoin) {
+      joined_.insert(req.request_rank);
+      last_joined_ = req.request_rank;
+      for (const auto& kv : table_) {
+        if (Complete(kv.second) &&
+            std::find(ready_.begin(), ready_.end(), kv.first) ==
+                ready_.end())
+          ready_.push_back(kv.first);
+      }
+      if (static_cast<int>(joined_.size()) == size_) {
+        Response rel;
+        rel.response_type = ResponseType::kJoin;
+        rel.tensor_sizes.push_back(last_joined_);
+        join_release_.push_back(std::move(rel));
+        joined_.clear();
+        return 1;
+      }
+      return 0;
+    }
     Pending& p = table_[req.tensor_name];
     if (p.requests.empty()) p.first_seen = MonotonicSeconds();
     if (p.ranks.count(req.request_rank)) return -1;
     p.ranks.insert(req.request_rank);
     p.requests.push_back(req);
-    if (static_cast<int>(p.ranks.size()) == size_) {
+    if (Complete(p)) {
       ready_.push_back(req.tensor_name);
       return 1;
     }
     return 0;
+  }
+
+  bool Complete(const Pending& p) const {
+    std::set<int32_t> u = p.ranks;
+    u.insert(joined_.begin(), joined_.end());
+    return static_cast<int>(u.size()) == size_;
   }
 
   // ≙ ConstructMPIResponse (operations.cc:255-461).
@@ -161,9 +189,15 @@ class Coordinator {
         }
       }
       if (error.empty()) {
+        // RANK-indexed extents: joined ranks contribute 0 rows.
+        std::map<int32_t, int64_t> by_rank;
         for (const Request& r : p.requests)
-          tensor_sizes.push_back(r.tensor_shape.empty() ? 0
-                                                        : r.tensor_shape[0]);
+          by_rank[r.request_rank] =
+              r.tensor_shape.empty() ? 0 : r.tensor_shape[0];
+        for (int32_t r = 0; r < size_; ++r) {
+          auto it = by_rank.find(r);
+          tensor_sizes.push_back(it == by_rank.end() ? 0 : it->second);
+        }
       }
     }
     if (error.empty() && op == RequestType::kBroadcast) {
@@ -189,6 +223,18 @@ class Coordinator {
           error = os.str();
         }
       }
+      if (error.empty() && static_cast<int>(p.requests.size()) < size_) {
+        bool root_present = false;
+        for (const Request& r : p.requests)
+          if (r.request_rank == first.root_rank) root_present = true;
+        if (!root_present) {
+          std::ostringstream os;
+          os << "Broadcast root rank " << first.root_rank
+             << " has joined; a joined rank cannot be the source of a "
+             << "broadcast.";
+          error = os.str();
+        }
+      }
     }
     // Host/device placement agreement (≙ operations.cc:418-440).
     for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
@@ -211,6 +257,10 @@ class Coordinator {
     }
     dtype_by_name_[name] = first.tensor_type;
     for (const Request& r : p.requests) resp.devices.push_back(r.device);
+    // dtype + shape ride every data response for joined ranks' zero
+    // contributions; BROADCAST also carries its root in tensor_sizes.
+    resp.tensor_type = static_cast<int>(first.tensor_type);
+    resp.tensor_shapes.push_back(first.tensor_shape);
     switch (op) {
       case RequestType::kAllreduce:
         resp.response_type = ResponseType::kAllreduce;
@@ -221,7 +271,10 @@ class Coordinator {
         break;
       case RequestType::kBroadcast:
         resp.response_type = ResponseType::kBroadcast;
+        resp.tensor_sizes.push_back(first.root_rank);
         break;
+      case RequestType::kJoin:
+        break;  // unreachable: JOIN never enters the tensor table
     }
     return resp;
   }
@@ -272,6 +325,9 @@ class Coordinator {
             dtype_by_name_[nxt.tensor_names[0]] == dt &&
             total + nbytes <= fusion_threshold_) {
           r.tensor_names.push_back(nxt.tensor_names[0]);
+          r.tensor_shapes.insert(r.tensor_shapes.end(),
+                                 nxt.tensor_shapes.begin(),
+                                 nxt.tensor_shapes.end());
           total += nbytes;
           responses.erase(responses.begin() + j);
         } else {
@@ -282,6 +338,10 @@ class Coordinator {
     }
     for (const auto& r : fused)
       for (const auto& n : r.tensor_names) dtype_by_name_.erase(n);
+    // JOIN release LAST: joined ranks execute this batch's data
+    // responses (zero contributions) before being released.
+    for (auto& jr : join_release_) fused.push_back(std::move(jr));
+    join_release_.clear();
     out_buffer_ = PackResponseList(fused);
     return static_cast<int>(fused.size());
   }
@@ -334,6 +394,9 @@ class Coordinator {
   std::map<std::string, Pending> table_;
   std::vector<std::string> ready_;
   std::vector<Response> withdrawn_;
+  std::set<int32_t> joined_;
+  int32_t last_joined_ = -1;
+  std::vector<Response> join_release_;
   std::unordered_map<std::string, DataType> dtype_by_name_;
   std::string out_buffer_;
 };

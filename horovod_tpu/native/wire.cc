@@ -93,6 +93,13 @@ std::string Response::Pack() const {
   for (int32_t d : devices) Append<int32_t>(&out, d);
   Append<uint16_t>(&out, static_cast<uint16_t>(tensor_sizes.size()));
   for (int64_t s : tensor_sizes) Append<int64_t>(&out, s);
+  Append<uint8_t>(&out, tensor_type < 0 ? 255
+                                        : static_cast<uint8_t>(tensor_type));
+  Append<uint16_t>(&out, static_cast<uint16_t>(tensor_shapes.size()));
+  for (const auto& shape : tensor_shapes) {
+    Append<uint8_t>(&out, static_cast<uint8_t>(shape.size()));
+    for (int64_t d : shape) Append<int64_t>(&out, d);
+  }
   return out;
 }
 

@@ -22,12 +22,13 @@ enum class DataType : uint8_t {
 
 const char* DataTypeName(DataType t);
 
-// ≙ MPIRequestType / MPIResponseType (mpi_message.h).
+// ≙ MPIRequestType / MPIResponseType (mpi_message.h); JOIN is the
+// post-v0.13 uneven-workload barrier (see ops/wire.py).
 enum class RequestType : uint8_t { kAllreduce = 0, kAllgather = 1,
-                                   kBroadcast = 2 };
+                                   kBroadcast = 2, kJoin = 3 };
 enum class ResponseType : uint8_t { kAllreduce = 0, kAllgather = 1,
                                     kBroadcast = 2, kError = 3, kDone = 4,
-                                    kShutdown = 5 };
+                                    kShutdown = 5, kJoin = 6 };
 
 constexpr int kCpuDeviceId = -1;  // ≙ CPU_DEVICE_ID (common.h:28)
 
@@ -52,7 +53,13 @@ struct Response {
   std::vector<std::string> tensor_names;
   std::string error_message;
   std::vector<int32_t> devices;
-  std::vector<int64_t> tensor_sizes;  // allgather dim-0 per rank
+  // ALLGATHER: dim-0 per rank (0 for joined ranks); BROADCAST:
+  // [root_rank]; JOIN: [last joining rank].
+  std::vector<int64_t> tensor_sizes;
+  // hvd.join support: validated dtype (-1 = absent, 255 on the wire)
+  // and per-fused-tensor shapes, for joined ranks' zero contributions.
+  int tensor_type = -1;
+  std::vector<std::vector<int64_t>> tensor_shapes;
 
   std::string Pack() const;
 };

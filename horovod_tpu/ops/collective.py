@@ -488,6 +488,12 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
             hm._get(o.handle).result = err  # surfaced at synchronize/poll
         return
 
+    if resp.response_type == ResponseType.JOIN:
+        # Release from hvd.join(): every rank joined; tensor_sizes
+        # carries the last joining rank (join()'s return value).
+        st.join_result = resp.tensor_sizes[0] if resp.tensor_sizes else -1
+        return
+
     if resp.response_type == ResponseType.SHUTDOWN:
         # A rank initiated shutdown (or died): flush everything pending
         # with the shut-down error — carrying the initiator's diagnosis
@@ -628,6 +634,14 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
     _, ks = _mp_kernels()
 
     if not ops:
+        if st.joining and resp.tensor_type is not None:
+            # This process called hvd.join(): participate in the peers'
+            # collective with ZERO contributions so the SPMD program
+            # still runs on every process (Horovod's Join semantics —
+            # post-v0.13; the v0.13 reference could only hang on uneven
+            # workloads).
+            _execute_response_mp_joined(resp)
+            return
         # The local op is gone (shutdown poisoning, or the local-fallback
         # withdrawal after the controller never answered a WITHDRAW
         # frame): skip this response rather than crash mid-list.  In the
@@ -710,6 +724,84 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
             if tl: tl.end(o.name, dtype=str(c.dtype))
             hm._get(o.handle).result = out
         return
+
+
+def _execute_response_mp_joined(resp: Response) -> None:
+    """Joined-rank execution of one data response: same jitted collective
+    over the process mesh, zero contribution built from the response's
+    dtype + shapes (wire fields added for exactly this)."""
+    st = _state.global_state()
+    _, ks = _mp_kernels()
+    dtype = wire.np_dtype_of(resp.tensor_type)
+    shapes = [tuple(s) for s in resp.tensor_shapes]
+
+    if resp.response_type == ResponseType.ALLREDUCE:
+        if len(shapes) == 1:
+            z = jnp.zeros(shapes[0], dtype)
+        else:
+            # Fused response: live ranks reduce one flat buffer.
+            n = sum(int(np.prod(s, dtype=np.int64)) if s else 1
+                    for s in shapes)
+            z = jnp.zeros((n,), dtype)
+        ks["psum_out_rep"](_mp_global(z))
+        return
+    if resp.response_type == ResponseType.ALLGATHER:
+        dmax = max(resp.tensor_sizes) if resp.tensor_sizes else 0
+        rest = shapes[0][1:]
+        ks["gather_pr"](_mp_global(jnp.zeros((dmax,) + rest, dtype)))
+        return
+    if resp.response_type == ResponseType.BROADCAST:
+        root = resp.tensor_sizes[0] if resp.tensor_sizes else 0
+        ks["bcast_pr"](_mp_global(jnp.zeros(shapes[0], dtype)),
+                       jnp.int32(root))
+
+
+def join() -> int:
+    """Barrier for uneven workloads (the post-v0.13 ``hvd.join()`` API).
+
+    A process that has run out of data calls ``join()``; until every
+    process joins, it keeps participating in the others' collectives
+    with ZERO contributions (allreduce adds zeros and still divides by
+    the full size — Horovod's documented Join semantics; allgather
+    contributes 0 rows).  Returns the rank of the LAST process to join,
+    so callers can e.g. pick a rank that saw every batch.  The v0.13
+    reference predates Join and could only hang on uneven workloads.
+
+    Single-process mode is trivially a no-op returning this rank: all
+    replicas advance in lockstep inside one program.
+    """
+    import os as _os
+    import time as _time
+
+    _state._check_initialized()
+    st = _state.global_state()
+    if not st.multiprocess:
+        return st.process_index
+    if st.peer_shutdown:
+        raise HorovodError(SHUT_DOWN_ERROR_MESSAGE)
+    req = wire.Request(st.process_index, wire.RequestType.JOIN,
+                       wire.DataType.UINT8, "hvd.join")
+    st.join_result = None
+    st.joining = True
+    try:
+        if st.process_index == 0:
+            st.coordinator.submit(req)
+        else:
+            st.transport.submit(req)
+        timeout = float(_os.environ.get("HOROVOD_TPU_JOIN_TIMEOUT", "600"))
+        deadline = _time.monotonic() + timeout
+        while st.join_result is None and _time.monotonic() < deadline:
+            if st.peer_shutdown:
+                raise HorovodError(SHUT_DOWN_ERROR_MESSAGE)
+            _drain()
+            _time.sleep(0.001)
+    finally:
+        st.joining = False
+    if st.join_result is None:
+        raise HorovodError(
+            f"hvd.join() timed out after {timeout:.0f}s waiting for the "
+            f"remaining processes to join (HOROVOD_TPU_JOIN_TIMEOUT).")
+    return st.join_result
 
 
 def _drain() -> None:

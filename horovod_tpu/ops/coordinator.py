@@ -86,6 +86,13 @@ class PyCoordinator:
         # ERROR responses queued by withdraw(); drained ahead of the ready
         # tensors by poll_responses.
         self._withdrawn: List[Response] = []
+        # Ranks that called hvd.join() (post-v0.13 uneven-workload
+        # barrier): they count as ready for every tensor and contribute
+        # zeros at execution.  When all ranks joined, a JOIN response
+        # releases them carrying the last joining rank.
+        self.joined: set = set()
+        self._last_joined: int = -1
+        self._join_release: List[Response] = []
         self.shutdown = False
 
     # -- withdraw (round 4; no reference equivalent — the reference can
@@ -107,9 +114,28 @@ class PyCoordinator:
     # -- IncrementTensorCount (operations.cc:222-247) ----------------------
     def submit(self, req: Request, now: Optional[float] = None) -> bool:
         """Record one replica's request; returns True when all replicas have
-        reported the tensor (negotiation complete)."""
+        reported the tensor (negotiation complete).  Joined ranks count
+        as ready for every tensor; a JOIN request may itself complete
+        pending tensors (and, from the last rank, the join barrier)."""
         now = time.monotonic() if now is None else now
         with self._lock:
+            if req.request_type == RequestType.JOIN:
+                self.joined.add(req.request_rank)
+                self._last_joined = req.request_rank
+                for name, entry in list(self.table.items()):
+                    if len(entry.ranks | self.joined) == self.size \
+                            and name not in self.ready:
+                        self.ready.append(name)
+                if len(self.joined) == self.size:
+                    # Released AFTER the data responses of the same poll:
+                    # a joined rank must still be joining (contributing
+                    # zeros) while those execute.
+                    self._join_release.append(Response(
+                        ResponseType.JOIN,
+                        tensor_sizes=[self._last_joined]))
+                    self.joined = set()
+                    return True
+                return False
             entry = self.table.get(req.tensor_name)
             if entry is None:
                 entry = _PendingTensor(first_seen=now)
@@ -121,7 +147,7 @@ class PyCoordinator:
                     f"most one pending collective per replica.")
             entry.requests.append(req)
             entry.ranks.add(req.request_rank)
-            if len(entry.ranks) == self.size:
+            if len(entry.ranks | self.joined) == self.size:
                 self.ready.append(req.tensor_name)
                 return True
             return False
@@ -190,7 +216,11 @@ class PyCoordinator:
                     if error:
                         break
             if error is None:
-                tensor_sizes = [r.tensor_shape[0] for r in reqs]
+                # RANK-indexed extents: joined ranks contribute 0 rows
+                # (identical to the old per-submitter list when no rank
+                # has joined).
+                by_rank = {r.request_rank: r.tensor_shape[0] for r in reqs}
+                tensor_sizes = [by_rank.get(r, 0) for r in range(self.size)]
         # Broadcast: root agreement + shape agreement
         # (operations.cc:396-431).
         if error is None and op == RequestType.BROADCAST:
@@ -210,6 +240,14 @@ class PyCoordinator:
                                  f"rank sent a tensor of shape "
                                  f"{list(r.tensor_shape)}.")
                         break
+            if error is None and len(reqs) < self.size \
+                    and first.root_rank not in {r.request_rank
+                                                for r in reqs}:
+                # Completed via joins and the root is among the joined:
+                # there is no data to broadcast.
+                error = (f"Broadcast root rank {first.root_rank} has "
+                         f"joined; a joined rank cannot be the source "
+                         f"of a broadcast.")
         # Device agreement (operations.cc:418-440): collectives must run on a
         # consistent device class across replicas.
         if error is None:
@@ -225,12 +263,18 @@ class PyCoordinator:
             return Response(ResponseType.ERROR, [name], error_message=error)
         self._resp_dtype[name] = first.tensor_type
         devices = [r.device for r in reqs]
+        # dtype + shape ride every data response so joined ranks can
+        # build zero contributions (hvd.join); BROADCAST also carries
+        # its root in tensor_sizes (a joined rank has no local op).
+        common = dict(devices=devices, tensor_type=first.tensor_type,
+                      tensor_shapes=[tuple(first.tensor_shape)])
         if op == RequestType.ALLREDUCE:
-            return Response(ResponseType.ALLREDUCE, [name], devices=devices)
+            return Response(ResponseType.ALLREDUCE, [name], **common)
         if op == RequestType.ALLGATHER:
-            return Response(ResponseType.ALLGATHER, [name], devices=devices,
-                            tensor_sizes=tensor_sizes)
-        return Response(ResponseType.BROADCAST, [name], devices=devices)
+            return Response(ResponseType.ALLGATHER, [name],
+                            tensor_sizes=tensor_sizes, **common)
+        return Response(ResponseType.BROADCAST, [name],
+                        tensor_sizes=[first.root_rank], **common)
 
     # -- Fusion loop (operations.cc:1328-1374) -----------------------------
     def poll_responses(self, sizes_bytes: Dict[str, int]) -> List[Response]:
@@ -242,6 +286,7 @@ class PyCoordinator:
         """
         with self._lock:
             withdrawn, self._withdrawn = self._withdrawn, []
+            release, self._join_release = self._join_release, []
             ready, self.ready = self.ready, []
             responses = [self._construct_response_locked(n) for n in ready]
         fused: List[Response] = list(withdrawn)
@@ -263,6 +308,7 @@ class PyCoordinator:
                         and total + sizes_bytes.get(nxt.tensor_names[0], 0)
                         <= self.fusion_threshold):
                     r.tensor_names.extend(nxt.tensor_names)
+                    r.tensor_shapes.extend(nxt.tensor_shapes)
                     total += sizes_bytes.get(nxt.tensor_names[0], 0)
                     responses.pop(j)
                 else:
@@ -271,6 +317,10 @@ class PyCoordinator:
         for r in fused:
             for n in r.tensor_names:
                 self._resp_dtype.pop(n, None)
+        # The JOIN release comes LAST: joined ranks must execute this
+        # batch's data responses (with zero contributions) before being
+        # released from join().
+        fused.extend(release)
         return fused
 
     # -- CheckForStalledTensors (operations.cc:1072-1115) ------------------

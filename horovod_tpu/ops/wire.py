@@ -86,21 +86,40 @@ def dtype_name(dt: DataType) -> str:
     return DataType(dt).name.lower()
 
 
+_DTYPE_TO_NP = {v: k for k, v in _NP_TO_DTYPE.items()}
+
+
+def np_dtype_of(dt: DataType):
+    """Wire DataType → numpy dtype (inverse of :func:`dtype_of`); a
+    joined rank uses it to build zero contributions from a Response."""
+    dt = DataType(dt)
+    if dt == DataType.BFLOAT16:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return _DTYPE_TO_NP[dt]
+
+
 def dtype_size(dt: DataType) -> int:
     return _DTYPE_SIZE[DataType(dt)]
 
 
 class RequestType(IntEnum):
-    """≙ MPIRequestType (mpi_message.h)."""
+    """≙ MPIRequestType (mpi_message.h), plus JOIN — the post-v0.13
+    Horovod barrier for uneven workloads (a rank out of data declares it
+    will contribute zeros to every remaining collective)."""
 
     ALLREDUCE = 0
     ALLGATHER = 1
     BROADCAST = 2
+    JOIN = 3
 
 
 class ResponseType(IntEnum):
     """≙ MPIResponseType (mpi_message.h) — ERROR carries a cross-replica
-    validation message; DONE/SHUTDOWN close the negotiation."""
+    validation message; DONE/SHUTDOWN close the negotiation; JOIN
+    releases every joined rank (tensor_sizes carries the last joining
+    rank, hvd.join()'s return value)."""
 
     ALLREDUCE = 0
     ALLGATHER = 1
@@ -108,6 +127,7 @@ class ResponseType(IntEnum):
     ERROR = 3
     DONE = 4
     SHUTDOWN = 5
+    JOIN = 6
 
 
 # Device id of a host-resident tensor (≙ CPU_DEVICE_ID, common.h:28).
@@ -166,9 +186,16 @@ class Response:
     tensor_names: List[str] = field(default_factory=list)
     error_message: str = ""
     devices: List[int] = field(default_factory=list)
-    # For ALLGATHER: dim-0 extent contributed by each replica, in rank order
-    # (ordering guarantee ≙ mpi_message.h:48-51).
+    # For ALLGATHER: dim-0 extent contributed by each replica, in RANK
+    # order with 0 for joined ranks (ordering ≙ mpi_message.h:48-51).
+    # For BROADCAST: [root_rank] (a joined rank has no local op to read
+    # the root from).  For JOIN: [last joining rank].
     tensor_sizes: List[int] = field(default_factory=list)
+    # Round 4 (hvd.join support): the validated dtype and each fused
+    # tensor's shape, aligned with tensor_names — a joined rank builds
+    # its zero contributions from these.  255 on the wire = no dtype.
+    tensor_type: Optional[DataType] = None
+    tensor_shapes: List[Tuple[int, ...]] = field(default_factory=list)
 
     def pack(self) -> bytes:
         out = struct.pack("<BH", int(self.response_type), len(self.tensor_names))
@@ -183,6 +210,13 @@ class Response:
         out += struct.pack("<H", len(self.tensor_sizes))
         for s in self.tensor_sizes:
             out += struct.pack("<q", s)
+        out += struct.pack("<B", 255 if self.tensor_type is None
+                           else int(self.tensor_type))
+        out += struct.pack("<H", len(self.tensor_shapes))
+        for shape in self.tensor_shapes:
+            out += struct.pack("<B", len(shape))
+            for d in shape:
+                out += struct.pack("<q", d)
         return out
 
     @staticmethod
@@ -207,7 +241,19 @@ class Response:
         off += 2
         sizes = list(struct.unpack_from(f"<{nsz}q", buf, off)) if nsz else []
         off += 8 * nsz
-        return Response(ResponseType(rt), names, err, devices, sizes), off
+        (tt,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        (nshp,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        shapes: List[Tuple[int, ...]] = []
+        for _ in range(nshp):
+            (ndim,) = struct.unpack_from("<B", buf, off)
+            off += 1
+            dims = struct.unpack_from(f"<{ndim}q", buf, off) if ndim else ()
+            off += 8 * ndim
+            shapes.append(tuple(dims))
+        return Response(ResponseType(rt), names, err, devices, sizes,
+                        None if tt == 255 else DataType(tt), shapes), off
 
 
 def pack_response_list(responses: List[Response]) -> bytes:

@@ -363,7 +363,26 @@ def scenario_withdraw(hvd):
     from horovod_tpu import HorovodError
 
     rank = hvd.rank()
-    assert float(os.environ["HOROVOD_TPU_SYNC_TIMEOUT"]) <= 3.0
+
+    def _sync_expect_abandoned(h, who: int, t0: float):
+        # The short timeout applies ONLY to the giving-up synchronize
+        # (the env is read per call), so the recovery collectives below
+        # — and any scenario sharing this launch — keep the default.
+        prev = os.environ.get("HOROVOD_TPU_SYNC_TIMEOUT")
+        os.environ["HOROVOD_TPU_SYNC_TIMEOUT"] = "2"
+        try:
+            hvd.synchronize(h)
+            raise AssertionError("expected the withdrawal error")
+        except HorovodError as e:
+            # The coordinator's message (not the local-fallback timeout
+            # text) proves the ERROR round trip happened.
+            assert f"was abandoned: rank {who}" in str(e), str(e)
+        finally:
+            if prev is None:
+                os.environ.pop("HOROVOD_TPU_SYNC_TIMEOUT", None)
+            else:
+                os.environ["HOROVOD_TPU_SYNC_TIMEOUT"] = prev
+        assert time.monotonic() - t0 < 20.0, "fail-fast regressed"
 
     # Leg 1 — a WORKER (rank 1) gives up: the WITHDRAW frame rides the
     # TCP control plane to the coordinator.
@@ -371,14 +390,7 @@ def scenario_withdraw(hvd):
     if rank == 1:
         h = hvd.allreduce_async(jnp.ones((2,)), name="abandoned.w",
                                 average=False)
-        try:
-            hvd.synchronize(h)
-            raise AssertionError("expected the withdrawal error")
-        except HorovodError as e:
-            # The coordinator's message (not the local-fallback timeout
-            # text) proves the ERROR round trip happened.
-            assert "was abandoned: rank 1" in str(e), str(e)
-        assert time.monotonic() - t0 < 20.0, "fail-fast regressed"
+        _sync_expect_abandoned(h, 1, t0)
     else:
         time.sleep(4.0)  # outlive the peer's timeout; never submit
     out = hvd.allreduce(jnp.ones((2,)), name="recover.w", average=False)
@@ -390,12 +402,7 @@ def scenario_withdraw(hvd):
     if rank == 0:
         h = hvd.allreduce_async(jnp.ones((2,)), name="abandoned.c",
                                 average=False)
-        try:
-            hvd.synchronize(h)
-            raise AssertionError("expected the withdrawal error")
-        except HorovodError as e:
-            assert "was abandoned: rank 0" in str(e), str(e)
-        assert time.monotonic() - t1 < 20.0, "fail-fast regressed"
+        _sync_expect_abandoned(h, 0, t1)
     else:
         time.sleep(4.0)
     out = hvd.allreduce(jnp.ones((2,)), name="recover.c", average=False)
@@ -427,6 +434,61 @@ def scenario_checkpoint(hvd):
     np.testing.assert_allclose(np.asarray(restored["w"]), 7.0)
     assert resume_epoch(path) == 5
     print(f"CKPT_OK rank={rank}")
+
+
+def scenario_join(hvd):
+    """hvd.join() across REAL processes (post-v0.13 API; the v0.13
+    reference could only hang on uneven workloads): rank 0 runs out of
+    data after 2 steps, rank 1 trains 4; the joined rank contributes
+    zeros until everyone joins; both learn the last joining rank.  The
+    barrier is reusable, and a broadcast whose root has joined fails
+    with a clean diagnosis instead of hanging."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import HorovodError
+
+    rank = hvd.rank()
+    steps = 2 if rank == 0 else 4
+    for i in range(steps):
+        out = hvd.allreduce(jnp.full((3,), float(rank + 1)),
+                            average=False, name=f"join.step.{i}")
+        want = 3.0 if i < 2 else 2.0  # rank 0 joined: zeros + rank 1's 2
+        np.testing.assert_allclose(np.asarray(out), want)
+        if i >= 2:
+            # Ragged allgather with a joined rank: 0 rows from rank 0.
+            g = hvd.allgather(jnp.full((2, 2), 7.0),
+                              name=f"join.gather.{i}")
+            assert np.asarray(g).shape == (2, 2), g.shape
+            np.testing.assert_allclose(np.asarray(g), 7.0)
+    assert hvd.join() == 1  # rank 1 joins last (it had more batches)
+
+    # The barrier is reusable; a joined root is a clean error.
+    if rank == 0:
+        assert hvd.join() == 1
+    else:
+        try:
+            hvd.broadcast(jnp.ones((2,)), root_rank=0, name="joined.root")
+            raise AssertionError("expected the joined-root error")
+        except HorovodError as e:
+            assert "has joined" in str(e), str(e)
+        assert hvd.join() == 1
+    out = hvd.allreduce(jnp.ones((2,)), name="post.join", average=False)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    print(f"JOIN_OK rank={rank}")
+
+
+def scenario_combo(hvd):
+    """Run several NON-DESTRUCTIVE scenarios sequentially in ONE launch
+    (``HVD_TPU_COMBO`` names them, comma-separated).  Every separate
+    launch pays full JAX init on every rank on the 1-core CI box, so
+    batching the scenarios that leave the group healthy — collectives,
+    mismatch validation, SPMD training, withdrawal recovery, stall
+    recovery, checkpoint, torch/tf frontends — cuts the suite's
+    wall-clock by minutes without losing any coverage: each scenario
+    still prints its own marker for the test to assert."""
+    for name in os.environ["HVD_TPU_COMBO"].split(","):
+        globals()[f"scenario_{name}"](hvd)
+    print(f"COMBO_OK rank={hvd.rank()}")
 
 
 def main():

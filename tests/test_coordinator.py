@@ -269,3 +269,61 @@ def test_withdraw_packed_response_parity():
             pack_response_list(nat.poll_responses({"p.op": 16}))
     finally:
         nat.close()
+
+
+def _join_req(rank):
+    return Request(rank, RequestType.JOIN, DataType.UINT8, "hvd.join")
+
+
+def test_join_completes_pending_and_releases(make_coord):
+    """hvd.join (post-v0.13): joined ranks count as ready for pending
+    tensors (zero contributions at execution); the last join queues the
+    release response carrying the last joining rank — AFTER the data
+    responses of the same poll."""
+    c = make_coord(3, 1 << 20)
+    assert c.submit(_req(0, "t")) is False
+    assert c.submit(_req(1, "t")) is False
+    # Rank 2 joins instead of submitting: the tensor completes.
+    assert c.submit(_join_req(2)) is False
+    resps = c.poll_responses({"t": 16})
+    assert [r.response_type for r in resps] == [ResponseType.ALLREDUCE]
+    # Zero-fill metadata rides the response.
+    assert resps[0].tensor_type == DataType.FLOAT32
+    assert [tuple(s) for s in resps[0].tensor_shapes] == [(4,)]
+    # A tensor submitted while a rank is joined completes immediately
+    # once the live ranks report.
+    assert c.submit(_req(0, "t2")) is False
+    assert c.submit(_req(1, "t2")) is True
+    c.submit(_join_req(0))
+    assert c.submit(_join_req(1)) is True
+    resps = c.poll_responses({"t2": 16})
+    assert [r.response_type for r in resps] == \
+        [ResponseType.ALLREDUCE, ResponseType.JOIN]
+    assert list(resps[-1].tensor_sizes) == [1]  # last joining rank
+
+
+def test_join_allgather_sizes_are_rank_indexed(make_coord):
+    c = make_coord(2, 1 << 20)
+    c.submit(_join_req(0))
+    c.submit(_req(1, "g", shape=(3, 2), op=RequestType.ALLGATHER))
+    resps = c.poll_responses({"g": 24})
+    [r] = [r for r in resps if r.response_type == ResponseType.ALLGATHER]
+    assert list(r.tensor_sizes) == [0, 3]  # joined rank 0 brings 0 rows
+
+
+def test_join_broadcast_root_joined_errors(make_coord):
+    c = make_coord(2, 1 << 20)
+    c.submit(_join_req(0))
+    c.submit(_req(1, "b", op=RequestType.BROADCAST, root=0))
+    resps = c.poll_responses({"b": 16})
+    [r] = [r for r in resps if r.response_type == ResponseType.ERROR]
+    assert "has joined" in r.error_message
+
+
+def test_broadcast_response_carries_root(make_coord):
+    c = make_coord(2, 1 << 20)
+    c.submit(_req(0, "b", op=RequestType.BROADCAST, root=1))
+    c.submit(_req(1, "b", op=RequestType.BROADCAST, root=1))
+    resps = c.poll_responses({"b": 16})
+    assert resps[0].response_type == ResponseType.BROADCAST
+    assert list(resps[0].tensor_sizes) == [1]
