@@ -376,12 +376,18 @@ def _supervise(args) -> int:
     # instead of eating three 10-minute compile attempts.
     probe_t = min(_PROBE_TIMEOUT, max(10.0, remaining() - _BUDGET_RESERVE))
     rc, probe, timed_out = _run_child(["--_probe"], probe_t)
-    if timed_out or rc != 0 or not (probe and probe.get("ok")):
+    # A salvaged ok payload from a timed-out child counts as a pass: the
+    # tunnel's known failure mode includes completing the work and then
+    # wedging at interpreter exit (see _run_child) — the measurement
+    # loop tolerates that, so the probe must too.
+    if not (probe and probe.get("ok")):
         why = ("probe timed out after "
                f"{probe_t:.0f}s (TPU tunnel down/hung?)" if timed_out
                else f"probe failed rc={rc}: {probe}")
         return _fail_json(f"tunnel probe failed: {why}", attempts=0)
-    print(f"tunnel probe ok: {probe.get('device_kind')}", file=sys.stderr)
+    print(f"tunnel probe ok: {probe.get('device_kind')}"
+          + (" (child wedged at exit)" if timed_out or rc != 0 else ""),
+          file=sys.stderr)
 
     # Phase 1 — measurement attempts, each clamped to remaining budget.
     last_err = "unknown"

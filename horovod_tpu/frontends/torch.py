@@ -32,6 +32,7 @@ Notes vs the reference:
 
 from __future__ import annotations
 
+import sys
 import weakref
 from typing import Dict, Iterable, Optional
 
@@ -120,14 +121,24 @@ def _finalize(entry: Optional[_Pending], raw) -> np.ndarray:
 
 def _write_back(entry: _Pending, result: np.ndarray) -> torch.Tensor:
     """Copy the finalized ``result`` into ``entry.target``, downgrade the
-    strong target reference to a weak one, and return the target."""
+    strong target reference to a weak one, and return the target.
+
+    Exception: when ours is (nearly) the only reference — the caller
+    passed a temporary view like ``p.data``, whose view object dies the
+    moment we let go even though its storage lives on in ``p`` — keep
+    the strong reference, so a later ``synchronize`` can still return
+    the result tensor.  (Cost: such a handle pins one view object until
+    synchronized; the common fire-and-forget case, where the caller
+    holds the tensor, still drops to a weakref.)"""
     target = entry.target
     out = _from_numpy(result, entry.dtype)
     if target.shape != out.shape:
         target.resize_(out.shape)
     target.copy_(out)
-    entry.target = None
     entry.done = True
+    # refs at this point: entry.target, local ``target``, getrefcount arg.
+    if sys.getrefcount(target) > 3:
+        entry.target = None
     return target
 
 
@@ -153,8 +164,10 @@ def poll(handle: int) -> bool:
             # manager, un-pinning the device-side result.
             target = _write_back(entry, _finalize(entry,
                                                   _C.synchronize(handle)))
-            entry.wref = weakref.ref(
-                target, lambda _r, h=handle: _inplace_targets.pop(h, None))
+            if entry.target is None:  # downgraded (caller holds the ref)
+                entry.wref = weakref.ref(
+                    target,
+                    lambda _r, h=handle: _inplace_targets.pop(h, None))
     return done
 
 
@@ -166,6 +179,8 @@ def synchronize(handle: int) -> torch.Tensor:
     if entry is not None and entry.done:
         # poll() already consumed the result and released the handle.
         _inplace_targets.pop(handle, None)
+        if entry.target is not None:  # temporary-view target kept strong
+            return entry.target
         target = entry.wref() if entry.wref is not None else None
         if target is None:
             raise ValueError(

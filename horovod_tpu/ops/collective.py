@@ -633,15 +633,19 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
     hm = st.handle_manager
     _, ks = _mp_kernels()
 
+    if st.joining and resp.tensor_type is not None \
+            and len(ops) < len(resp.tensor_names):
+        # This process called hvd.join(): participate in the peers'
+        # collective with ZERO contributions so the SPMD program still
+        # runs on every process (Horovod's Join semantics — post-v0.13;
+        # the v0.13 reference could only hang on uneven workloads).
+        # ``ops`` may be a PARTIAL subset: an async op this rank
+        # submitted before joining can fuse with tensors completed by
+        # its JOIN — the mixed buffer must still match the peers'.
+        _execute_response_mp_joined(resp, ops)
+        return
+
     if not ops:
-        if st.joining and resp.tensor_type is not None:
-            # This process called hvd.join(): participate in the peers'
-            # collective with ZERO contributions so the SPMD program
-            # still runs on every process (Horovod's Join semantics —
-            # post-v0.13; the v0.13 reference could only hang on uneven
-            # workloads).
-            _execute_response_mp_joined(resp)
-            return
         # The local op is gone (shutdown poisoning, or the local-fallback
         # withdrawal after the controller never answered a WITHDRAW
         # frame): skip this response rather than crash mid-list.  In the
@@ -726,24 +730,51 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
         return
 
 
-def _execute_response_mp_joined(resp: Response) -> None:
+def _execute_response_mp_joined(resp: Response,
+                                ops: List["_QueuedOp"] = ()) -> None:
     """Joined-rank execution of one data response: same jitted collective
-    over the process mesh, zero contribution built from the response's
-    dtype + shapes (wire fields added for exactly this)."""
+    over the process mesh, zero contributions built from the response's
+    dtype + shapes (wire fields added for exactly this).  ``ops`` holds
+    any of the rank's OWN outstanding async ops that rode the same fused
+    response — they contribute their real values (exactly like the live
+    path) and receive their slice of the result."""
     st = _state.global_state()
+    hm = st.handle_manager
     _, ks = _mp_kernels()
     dtype = wire.np_dtype_of(resp.tensor_type)
     shapes = [tuple(s) for s in resp.tensor_shapes]
+    by_name = {o.name: o for o in ops}
 
     if resp.response_type == ResponseType.ALLREDUCE:
-        if len(shapes) == 1:
-            z = jnp.zeros(shapes[0], dtype)
-        else:
-            # Fused response: live ranks reduce one flat buffer.
-            n = sum(int(np.prod(s, dtype=np.int64)) if s else 1
-                    for s in shapes)
-            z = jnp.zeros((n,), dtype)
-        ks["psum_out_rep"](_mp_global(z))
+        def numel(s):
+            return int(np.prod(s, dtype=np.int64)) if s else 1
+
+        if len(resp.tensor_names) == 1:
+            o = by_name.get(resp.tensor_names[0])
+            val = o.contrib.value if o is not None \
+                else jnp.zeros(shapes[0], dtype)
+            out = ks["psum_out_rep"](_mp_global(val))
+            if o is not None:
+                if o.average:
+                    out = _divide(out, st.process_count)
+                hm._get(o.handle).result = out
+            return
+        # Fused: the peers reduce ONE flat buffer — build the identical
+        # buffer with zeros in the slots this rank never submitted.
+        parts = [jnp.ravel(by_name[n].contrib.value) if n in by_name
+                 else jnp.zeros((numel(s),), dtype)
+                 for n, s in zip(resp.tensor_names, shapes)]
+        red = ks["psum_out_rep"](_mp_global(jnp.concatenate(parts)))
+        offs = 0
+        for n, s in zip(resp.tensor_names, shapes):
+            o = by_name.get(n)
+            cnt = numel(s)
+            if o is not None:
+                piece = red[offs:offs + cnt].reshape(s)
+                if o.average:
+                    piece = _divide(piece, st.process_count)
+                hm._get(o.handle).result = piece
+            offs += cnt
         return
     if resp.response_type == ResponseType.ALLGATHER:
         dmax = max(resp.tensor_sizes) if resp.tensor_sizes else 0

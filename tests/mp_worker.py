@@ -474,6 +474,29 @@ def scenario_join(hvd):
         assert hvd.join() == 1
     out = hvd.allreduce(jnp.ones((2,)), name="post.join", average=False)
     np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    # Round 3 of the barrier: an async op outstanding ACROSS join().  It
+    # can FUSE with a tensor completed by this rank's join, so the
+    # joined rank must execute the mixed buffer — its real value in its
+    # own slot, zeros in the peer-only slot — identically to the peers'
+    # fused flat buffer (round-4 review finding).  (Fusion of the two
+    # tensors depends on them becoming ready within one 5 ms tick —
+    # overwhelmingly likely with back-to-back submits; if they miss, the
+    # assertions still hold via unfused responses.)
+    if rank == 0:
+        h = hvd.allreduce_async(jnp.full((4,), 1.0), name="fuse.mine",
+                                average=False)
+        assert hvd.join() == 1
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)), 3.0)
+    else:
+        time.sleep(0.5)  # rank 0's submit + JOIN land first
+        ha = hvd.allreduce_async(jnp.full((4,), 2.0), name="fuse.mine",
+                                 average=False)
+        hb = hvd.allreduce_async(jnp.full((2,), 5.0), name="fuse.peer",
+                                 average=False)
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(ha)), 3.0)
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(hb)), 5.0)
+        assert hvd.join() == 1
     print(f"JOIN_OK rank={rank}")
 
 

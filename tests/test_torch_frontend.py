@@ -113,6 +113,21 @@ def test_inplace_fire_and_forget_pins_nothing(thvd):
         thvd.synchronize(h)
 
 
+def test_inplace_poll_then_synchronize_temporary_view(thvd):
+    """p.data-style TEMPORARY view target: poll's write-back must keep
+    the view alive (refcount heuristic) so a later synchronize still
+    returns the result tensor — and the parameter storage is updated."""
+    size = thvd.size()
+    p = torch.nn.Parameter(torch.ones(4))
+    h = thvd.allreduce_async_(p.data, average=False, name="view.t")
+    _poll_until_done(thvd, h)
+    out = thvd.synchronize(h)
+    np.testing.assert_allclose(out.detach().numpy(),
+                               np.full(4, float(size)))
+    np.testing.assert_allclose(p.detach().numpy(),
+                               np.full(4, float(size)))
+
+
 def test_inplace_poll_synchronize_after_target_dropped(thvd):
     """Target GC'd between poll-completion and synchronize: the result
     went with the tensor, so synchronize raises a clear error."""
