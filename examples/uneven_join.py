@@ -1,0 +1,59 @@
+"""Uneven-workload training with ``hvd.join()``.
+
+The post-v0.13 Horovod API this demonstrates: when ranks have different
+amounts of data, the fast ranks call ``join()`` after their last batch
+and contribute zeros to the slow ranks' remaining allreduces (which
+still divide by the full size — Horovod's documented Join semantics).
+``join()`` returns the LAST rank to join, i.e. the rank that saw every
+one of its batches — the natural source for the final model broadcast.
+The v0.13 reference predates Join and could only hang here.
+
+Run (2 processes, CPU):
+
+    python -m horovod_tpu.run -np 2 --platform cpu examples/uneven_join.py
+
+Env knobs: ``HVD_TPU_EXAMPLE_STEPS`` (base step count, default 4; rank r
+runs base + 2*r steps).
+"""
+
+import os
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    base = int(os.environ.get("HVD_TPU_EXAMPLE_STEPS", "4"))
+    steps = base + 2 * rank  # genuinely uneven: rank r has 2r extra batches
+
+    w_true = np.array([2.0, -1.0], dtype="float32")
+    rng = np.random.RandomState(rank)
+    X = rng.normal(size=(steps, 16, 2)).astype("float32")
+    y = X @ w_true
+
+    w = hvd.broadcast(jnp.zeros((2,)), root_rank=0, name="w.init")
+    for i in range(steps):
+        xb, yb = jnp.asarray(X[i]), jnp.asarray(y[i])
+        grad = 2.0 * xb.T @ (xb @ w - yb) / xb.shape[0]
+        # Ranks that already joined contribute zeros here.
+        grad = hvd.allreduce(grad, average=True, name=f"grad.{i}")
+        w = w - 0.1 * grad
+
+    last = hvd.join()
+    # The last joiner consumed every one of its batches — broadcast its
+    # weights as the final model so all ranks agree.
+    w = hvd.broadcast(w, root_rank=last, name="w.final")
+    err = float(jnp.sum(jnp.abs(w - jnp.asarray(w_true))))
+    print(f"uneven_join: OK rank={rank} size={size} steps={steps} "
+          f"last_joined={last} w={np.asarray(w).round(3).tolist()} "
+          f"err={err:.3f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

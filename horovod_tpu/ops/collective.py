@@ -348,6 +348,12 @@ def _mp_global(x: jax.Array):
     process mesh (this process supplies shard ``process_index``)."""
     st = _state.global_state()
     mesh, _ = _mp_kernels()
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        # A previous collective's (replicated) output — or eager math on
+        # one — fed straight back in: take this process's full local
+        # copy so device_put gets an addressable array (users naturally
+        # chain collectives, e.g. allreduce(f(broadcast(w)))).
+        x = np.asarray(x.addressable_data(0))
     # The shard this process owns lives on its device in the process mesh.
     mine = [d for d in mesh.devices.flat
             if d.process_index == st.process_index][0]

@@ -84,3 +84,27 @@ def test_resnet50_synthetic_example():
     assert "resumed from epoch 1" in out
     assert "epoch 1:" in out
     assert "checkpoint saved" in out
+
+
+@pytest.mark.slow
+def test_uneven_join_example():
+    """hvd.join example under the real 2-process launcher: the fast rank
+    joins, the slow rank finishes, the last joiner's weights broadcast."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    env["HVD_TPU_EXAMPLE_STEPS"] = "3"
+    # The launcher's children run the script directly (no installed
+    # package): put the repo on their import path.
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--platform", "cpu", os.path.join(REPO, "examples",
+                                           "uneven_join.py")],
+        env=env, cwd=REPO, capture_output=True, timeout=300)
+    out = proc.stdout.decode() + proc.stderr.decode()
+    assert proc.returncode == 0, out
+    assert "uneven_join: OK rank=0" in out
+    assert "uneven_join: OK rank=1" in out
+    assert "last_joined=1" in out
