@@ -20,8 +20,8 @@ mesh, composing:
 Parameter storage is replicated; sharded *compute* slices its shard
 in-trace (``local_shard`` / ``select_stage_params`` / ``local_experts``).
 This keeps the optimizer and Horovod-parity broadcast/checkpoint paths
-strategy-agnostic; sharded parameter *storage* (FSDP-style) is a planned
-extension.
+strategy-agnostic; for sharded parameter *storage* compose any loss with
+the model-agnostic FSDP/ZeRO-3 builder (:mod:`..parallel.fsdp`).
 """
 
 from __future__ import annotations

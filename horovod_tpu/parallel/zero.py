@@ -100,13 +100,24 @@ def _flat_shard(tree, n: int):
     return shard, unravel, true_size
 
 
-def _check_elementwise(optimizer) -> None:
+def _sharded_state_specs(opt_state):
+    """Per-leaf PartitionSpecs for a flat-sharded optimizer state:
+    vector leaves (momentum/variance slices) shard over the replica
+    axis, scalar leaves (e.g. Adam's step count) replicate.  Shared by
+    the ZeRO-1 and FSDP builders."""
+    return jax.tree_util.tree_map(
+        lambda leaf: P(REPLICA_AXIS) if getattr(leaf, "ndim", 0)
+        else P(), opt_state)
+
+
+def _check_elementwise(optimizer, feature: str = "ZeRO-1",
+                       api_name: str = "make_zero_train_step") -> None:
     """Build-time probe for the elementwise-optimizer precondition.
 
     An elementwise transform updates a concatenated vector exactly as it
     updates the parts with independent states — which is precisely how
-    ZeRO-1 will run it (each replica updates its shard with its shard of
-    state).  A transform that aggregates across the tree
+    the sharded builders run it (each replica updates its shard with its
+    shard of state).  A transform that aggregates across the tree
     (``clip_by_global_norm``: the norm of a half differs from the norm
     of the whole) fails the probe and would silently train wrong.
 
@@ -129,24 +140,24 @@ def _check_elementwise(optimizer) -> None:
         full = np.asarray(full)
     except TypeError as e:
         warnings.warn(
-            "make_zero_train_step could not probe the optimizer for the "
+            f"{api_name} could not probe the optimizer for the "
             f"elementwise precondition ({e}); proceeding unchecked — "
             "ensure no transform aggregates across parameters "
             "(see horovod_tpu/parallel/zero.py docstring)")
         return
     if not np.allclose(full, np.concatenate(parts), rtol=1e-5, atol=1e-5):
         raise ValueError(
-            "ZeRO-1 requires an ELEMENTWISE optimizer: updating a vector "
-            "must equal updating its parts independently, because each "
-            "replica will only ever see its 1/N shard of the gradients "
-            "and optimizer state.  The given optax chain failed that "
-            "probe — it aggregates across parameters (e.g. "
+            f"{feature} requires an ELEMENTWISE optimizer: updating a "
+            "vector must equal updating its parts independently, because "
+            "each replica will only ever see its 1/N shard of the "
+            "gradients and optimizer state.  The given optax chain "
+            "failed that probe — it aggregates across parameters (e.g. "
             "optax.clip_by_global_norm computes the GLOBAL gradient "
-            "norm, but under ZeRO-1 each replica would clip by its "
+            f"norm, but under {feature} each replica would clip by its "
             "shard's norm, silently training wrong).  Alternatives: "
             "clip per-element with optax.clip(delta); clip by global "
             "norm OUTSIDE the optimizer on the full gradient before "
-            "ZeRO-1 sees it; or pass validate_elementwise=False to "
+            f"{feature} sees it; or pass validate_elementwise=False to "
             "accept shard-local semantics.")
 
 
@@ -238,16 +249,11 @@ def make_zero_train_step(
             return params, model_state, opt_state, loss
         return params, opt_state, loss
 
-    # Optimizer states mix vector leaves (momentum/variance slices —
-    # sharded over the replica axis) with scalar leaves (e.g. Adam's
-    # step count — identical on every replica, so replicated).  The
-    # per-leaf specs depend on the state's structure, which optax only
-    # reveals given the (chunk-sized) param slice, so the jitted
-    # programs are built lazily and cached by state structure.
-    def _state_specs(opt_state):
-        return jax.tree_util.tree_map(
-            lambda leaf: P(REPLICA_AXIS) if getattr(leaf, "ndim", 0)
-            else P(), opt_state)
+    # The per-leaf state specs (_sharded_state_specs) depend on the
+    # state's structure, which optax only reveals given the
+    # (chunk-sized) param slice, so the jitted programs are built
+    # lazily and cached by state structure.
+    _state_specs = _sharded_state_specs
 
     init_cache: dict = {}
 
