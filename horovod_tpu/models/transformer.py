@@ -153,12 +153,16 @@ def _attention_block(x, lp, cfg: TransformerConfig, ax: ParallelAxes,
     heads_loc = cfg.n_heads // mp
     head_dim = d // cfg.n_heads
 
-    def split_heads(w):
-        y = column_parallel(h, w, axis_name=ax.model or T.MODEL_AXIS)
+    def split_heads(y):
         return y.reshape(b, s_loc, heads_loc, head_dim).transpose(
             0, 2, 1, 3)
 
-    q, k, v = split_heads(wq), split_heads(wk), split_heads(wv)
+    # One fused [d, 3*d_local] projection instead of three separate
+    # gemms: XLA does not merge gemms horizontally, and the wider
+    # matmul tiles the MXU better at transformer widths.
+    qkv = column_parallel(h, jnp.concatenate([wq, wk, wv], axis=-1),
+                          axis_name=ax.model or T.MODEL_AXIS)
+    q, k, v = (split_heads(y) for y in jnp.split(qkv, 3, axis=-1))
     if ax.seq is not None:
         attn = ring_attention(q, k, v, axis_name=ax.seq, causal=True,
                               block_q=cfg.block_q, block_k=cfg.block_k)

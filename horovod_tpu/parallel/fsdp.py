@@ -52,8 +52,8 @@ from ..core import state as _state
 from ..core.state import REPLICA_AXIS
 from .data import DistributedOptimizer
 from .training import _throttle_on_cpu
-from .zero import (_check_elementwise, _pad_flat, _replica_count,
-                   _sharded_state_specs)
+from .zero import (_abstract_state_or_raise, _check_elementwise,
+                   _pad_flat, _replica_count, _sharded_state_specs)
 
 try:
     import optax
@@ -123,7 +123,6 @@ def make_fsdp_train_step(
     # Flat layout (unravel closure, true size, chunk) is fixed by the
     # parameter structure at init() time; step()/full_params() read it.
     layout: dict = {}
-    _state_specs = _sharded_state_specs
 
     def init(params):
         flat, unravel, true_size = _pad_flat(params, n)
@@ -132,22 +131,9 @@ def make_fsdp_train_step(
         layout["true_size"] = true_size
         layout["chunk"] = chunk
 
-        abstract = jax.eval_shape(
-            optimizer.init, jax.ShapeDtypeStruct((chunk,), flat.dtype))
-        bad = [tuple(leaf.shape)
-               for leaf in jax.tree_util.tree_leaves(abstract)
-               if getattr(leaf, "ndim", 0) >= 1
-               and tuple(leaf.shape) != (chunk,)]
-        if bad:
-            raise ValueError(
-                "FSDP shards every non-scalar optimizer-state leaf over "
-                "the replica axis, so each such leaf must be one "
-                f"({chunk},)-shaped per-parameter slice; the given "
-                f"optimizer's state has leaves of shape {bad}.  This "
-                "usually means a non-elementwise transform or an "
-                "array-valued hyperparameter (optax.inject_hyperparams) "
-                "— keep those outside make_fsdp_train_step (see "
-                "parallel/zero.py docstring).")
+        abstract = _abstract_state_or_raise(
+            optimizer, chunk, flat.dtype, feature="FSDP",
+            api_name="make_fsdp_train_step")
 
         def shard_and_init(flat_padded):
             idx = jax.lax.axis_index(REPLICA_AXIS)
@@ -157,7 +143,7 @@ def make_fsdp_train_step(
 
         jitted = jax.jit(jax.shard_map(
             shard_and_init, mesh=mesh, in_specs=(P(),),
-            out_specs=(P(REPLICA_AXIS), _state_specs(abstract)),
+            out_specs=(P(REPLICA_AXIS), _sharded_state_specs(abstract)),
             check_vma=False), donate_argnums=(0,))
         return jitted(flat)
 
@@ -216,7 +202,7 @@ def make_fsdp_train_step(
     step_cache: dict = {}
 
     def _compiled(opt_state):
-        specs = _state_specs(opt_state)
+        specs = _sharded_state_specs(opt_state)
         key = jax.tree_util.tree_structure(specs), tuple(
             str(s) for s in jax.tree_util.tree_leaves(
                 specs, is_leaf=lambda x: isinstance(x, P)))

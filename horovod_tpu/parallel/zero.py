@@ -110,6 +110,38 @@ def _sharded_state_specs(opt_state):
         else P(), opt_state)
 
 
+def _abstract_state_or_raise(optimizer, chunk: int, dtype,
+                             feature: str = "ZeRO-1",
+                             api_name: str = "make_zero_train_step"):
+    """Abstract optimizer state for a (chunk,)-sized slice, refusing
+    states whose non-scalar leaves are not per-parameter slices.
+
+    :func:`_sharded_state_specs` shards every ndim>=1 state leaf over
+    the replica axis, which is only correct for chunk-sized
+    per-parameter vectors (momentum/variance slices).  A leaf of any
+    other shape (an array hyperparameter from ``inject_hyperparams``, a
+    non-elementwise transform's aggregate) would get silently wrong
+    sharding — refuse at build time.  Shared by the ZeRO-1 and FSDP
+    builders."""
+    abstract = jax.eval_shape(
+        optimizer.init, jax.ShapeDtypeStruct((chunk,), dtype))
+    bad = [tuple(leaf.shape)
+           for leaf in jax.tree_util.tree_leaves(abstract)
+           if getattr(leaf, "ndim", 0) >= 1
+           and tuple(leaf.shape) != (chunk,)]
+    if bad:
+        raise ValueError(
+            f"{feature} shards every non-scalar optimizer-state "
+            "leaf over the replica axis, so each such leaf must "
+            f"be one ({chunk},)-shaped per-parameter slice; the "
+            f"given optimizer's state has leaves of shape {bad}. "
+            "This usually means a non-elementwise transform or "
+            "an array-valued hyperparameter "
+            "(optax.inject_hyperparams) — keep those outside "
+            f"{api_name} (see parallel/zero.py docstring).")
+    return abstract
+
+
 def _check_elementwise(optimizer, feature: str = "ZeRO-1",
                        api_name: str = "make_zero_train_step") -> None:
     """Build-time probe for the elementwise-optimizer precondition.
@@ -264,29 +296,7 @@ def make_zero_train_step(
         dtype = jnp.result_type(*[l.dtype for l in leaves])
         key = (chunk, str(dtype))
         if key not in init_cache:
-            abstract = jax.eval_shape(
-                optimizer.init, jax.ShapeDtypeStruct((chunk,), dtype))
-            # _state_specs shards every ndim>=1 state leaf over the
-            # replica axis, which is only correct for chunk-sized
-            # per-parameter vectors (momentum/variance slices).  A leaf
-            # of any other shape (an array hyperparameter from
-            # inject_hyperparams, a non-elementwise transform's
-            # aggregate) would get silently wrong sharding — refuse.
-            bad = [tuple(leaf.shape)
-                   for leaf in jax.tree_util.tree_leaves(abstract)
-                   if getattr(leaf, "ndim", 0) >= 1
-                   and tuple(leaf.shape) != (chunk,)]
-            if bad:
-                raise ValueError(
-                    "ZeRO-1 shards every non-scalar optimizer-state "
-                    "leaf over the replica axis, so each such leaf must "
-                    f"be one ({chunk},)-shaped per-parameter slice; the "
-                    f"given optimizer's state has leaves of shape {bad}. "
-                    "This usually means a non-elementwise transform or "
-                    "an array-valued hyperparameter "
-                    "(optax.inject_hyperparams) — keep those outside "
-                    "make_zero_train_step (see parallel/zero.py "
-                    "docstring).")
+            abstract = _abstract_state_or_raise(optimizer, chunk, dtype)
             init_cache[key] = jax.jit(jax.shard_map(
                 per_replica_init, mesh=mesh,
                 in_specs=(P(),), out_specs=_state_specs(abstract),
