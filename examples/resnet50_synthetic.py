@@ -37,6 +37,9 @@ def main():
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--zero", action="store_true",
                     help="ZeRO-1: shard optimizer state across replicas")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="FSDP/ZeRO-3: shard parameters AND optimizer "
+                         "state across replicas")
     args = ap.parse_args()
 
     hvd.init()
@@ -69,7 +72,7 @@ def main():
 
     trainer = Trainer(
         loss_fn, params, lr=base_lr, optimizer_kwargs={"momentum": 0.9},
-        model_state=stats, zero=args.zero,
+        model_state=stats, zero=args.zero, fsdp=args.fsdp,
         callbacks=[
             callbacks.BroadcastGlobalVariablesCallback(0),
             callbacks.MetricAverageCallback(),

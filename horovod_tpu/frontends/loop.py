@@ -49,6 +49,13 @@ class Trainer:
         would see only their local shard).  ``fusion_threshold`` does
         not apply in this mode: the flattened gradient is one maximal
         fusion bucket.
+      fsdp: FSDP/ZeRO-3 fully-sharded storage (see
+        :mod:`horovod_tpu.parallel.fsdp`): parameters AND optimizer
+        state live as 1/N flat shards between steps.  ``trainer.params``
+        stays the full pytree contract — reading it gathers the shards,
+        assigning it re-shards — so every callback (broadcast,
+        checkpoint) works unchanged; the hot loop itself runs on the
+        shard.  Same elementwise-optimizer precondition as ``zero``.
     """
 
     def __init__(self, loss_fn, params, optimizer_fn=optax.sgd,
@@ -56,16 +63,40 @@ class Trainer:
                  callbacks: Optional[Sequence] = None, model_state=None,
                  average_gradients: bool = True,
                  fusion_threshold: Optional[int] = None,
-                 zero: bool = False):
+                 zero: bool = False, fsdp: bool = False):
         _state._check_initialized()
-        self.params = params
+        if zero and fsdp:
+            raise ValueError("zero and fsdp are mutually exclusive: "
+                             "fsdp shards everything zero does and the "
+                             "parameters too")
+        self._fsdp = fsdp
+        self._fstep = None
+        if not fsdp:
+            self.params = params
         self.model_state = model_state
         self._has_state = model_state is not None
         kwargs = dict(optimizer_kwargs or {})
         self._momentum_key = "momentum" if "momentum" in kwargs else None
         self.optimizer = optax.inject_hyperparams(optimizer_fn)(
             learning_rate=lr, **kwargs)
-        if zero:
+        if (zero or fsdp) and fusion_threshold is not None:
+            import warnings
+
+            warnings.warn(
+                f"fusion_threshold is ignored with "
+                f"{'fsdp' if fsdp else 'zero'}=True: the flattened "
+                "gradient is one maximal fusion bucket", stacklevel=2)
+        if fsdp:
+            from ..parallel.fsdp import (make_fsdp_train_step,
+                                         make_fsdp_train_step_with_state)
+
+            builder = (make_fsdp_train_step_with_state if self._has_state
+                       else make_fsdp_train_step)
+            self._fstep = builder(loss_fn, self.optimizer,
+                                  average=average_gradients, donate=False)
+            self._p_shard, self.opt_state = self._fstep.init(params)
+            self._step = self._fstep.step
+        elif zero:
             # ZeRO-1: sharded optimizer state (parallel/zero.py).  The
             # step/opt_state contracts match the replicated builders, so
             # callbacks (LR mutation included — hyperparams are
@@ -73,13 +104,6 @@ class Trainer:
             from ..parallel.zero import (make_zero_train_step,
                                          make_zero_train_step_with_state)
 
-            if fusion_threshold is not None:
-                import warnings
-
-                warnings.warn(
-                    "fusion_threshold is ignored with zero=True: the "
-                    "flattened gradient is one maximal fusion bucket",
-                    stacklevel=2)
             builder = (make_zero_train_step_with_state if self._has_state
                        else make_zero_train_step)
             zstep = builder(loss_fn, self.optimizer,
@@ -100,6 +124,24 @@ class Trainer:
         self.history: List[dict] = []
         self.steps_per_epoch: Optional[int] = None
         self.stop_training = False
+
+    # -- parameter access: the pytree contract survives fsdp ------------
+    @property
+    def params(self):
+        """The full parameter pytree.  Under ``fsdp=True`` reading
+        gathers the 1/N shards and assigning re-shards, so callbacks
+        (broadcast at train begin, rank-0 checkpointing) see the same
+        contract as every other mode."""
+        if self._fsdp:
+            return self._fstep.full_params(self._p_shard)
+        return self._params
+
+    @params.setter
+    def params(self, value) -> None:
+        if getattr(self, "_fsdp", False):
+            self._p_shard = self._fstep.shard_params(value)
+        else:
+            self._params = value
 
     # -- hyperparameter access for callbacks (≙ K.get/set_value on
     #    optimizer.lr / optimizer.momentum) ------------------------------
@@ -156,7 +198,18 @@ class Trainer:
             for step in range(steps_per_epoch):
                 self._call("on_batch_begin", step, None)
                 batch = shard_batch(batches(epoch, step))
-                if self._has_state:
+                if self._fsdp:
+                    # The hot loop runs on the shard directly — no
+                    # per-step gather through the params property.
+                    if self._has_state:
+                        (self._p_shard, self.model_state, self.opt_state,
+                         loss) = self._step(self._p_shard,
+                                            self.model_state,
+                                            self.opt_state, batch)
+                    else:
+                        self._p_shard, self.opt_state, loss = self._step(
+                            self._p_shard, self.opt_state, batch)
+                elif self._has_state:
                     (self.params, self.model_state, self.opt_state,
                      loss) = self._step(self.params, self.model_state,
                                         self.opt_state, batch)

@@ -65,13 +65,16 @@ class FsdpTrainStep(NamedTuple):
     """``init(params) -> (param_shard, opt_state)`` (both sharded 1/N
     per replica), ``step(param_shard, opt_state, batch) ->
     (param_shard, opt_state, loss)`` (stateful variant threads
-    ``model_state`` after ``param_shard``), and
-    ``full_params(param_shard) -> params`` (the unsharded pytree, for
-    checkpointing and evaluation)."""
+    ``model_state`` after ``param_shard``), ``full_params(param_shard)
+    -> params`` (the unsharded pytree, for checkpointing and
+    evaluation), and ``shard_params(params) -> param_shard`` (re-shard
+    a full pytree without touching optimizer state — checkpoint restore,
+    broadcast-then-reshard)."""
 
     init: Callable[[Any], Any]
     step: Callable[..., Any]
     full_params: Callable[[Any], Any]
+    shard_params: Callable[[Any], Any]
 
 
 def make_fsdp_train_step(
@@ -121,24 +124,47 @@ def make_fsdp_train_step(
                            api_name="make_fsdp_train_step")
 
     # Flat layout (unravel closure, true size, chunk) is fixed by the
-    # parameter structure at init() time; step()/full_params() read it.
+    # parameter structure at init()/shard_params() time; step()/
+    # full_params() read it.  Jitted slicers cached by chunk size.
     layout: dict = {}
+    _shard_cache: dict = {}
 
-    def init(params):
+    def _capture_layout(params):
+        # One builder serves one parameter structure: a later pytree
+        # with the same element count but different leaf order would
+        # silently misalign the already-sharded optimizer state, so any
+        # structural change fails loudly here.
+        sig = (jax.tree_util.tree_structure(params),
+               tuple((tuple(leaf.shape), str(leaf.dtype)) for leaf in
+                     jax.tree_util.tree_leaves(params)))
+        if layout and layout["sig"] != sig:
+            raise ValueError(
+                "make_fsdp_train_step: parameter pytree structure "
+                "differs from the one captured at init() — the sharded "
+                "optimizer state is laid out for the original flat "
+                "ordering, so re-sharding a different structure would "
+                "silently apply wrong per-element state.  Build a new "
+                "step for a new model structure.")
         flat, unravel, true_size = _pad_flat(params, n)
-        chunk = flat.size // n
+        layout["sig"] = sig
         layout["unravel"] = unravel
         layout["true_size"] = true_size
-        layout["chunk"] = chunk
+        layout["chunk"] = flat.size // n
+        return flat, layout["chunk"]
 
+    def _local_chunk(flat_padded, chunk):
+        idx = jax.lax.axis_index(REPLICA_AXIS)
+        return jax.lax.dynamic_slice(flat_padded, (idx * chunk,),
+                                     (chunk,))
+
+    def init(params):
+        flat, chunk = _capture_layout(params)
         abstract = _abstract_state_or_raise(
             optimizer, chunk, flat.dtype, feature="FSDP",
             api_name="make_fsdp_train_step")
 
         def shard_and_init(flat_padded):
-            idx = jax.lax.axis_index(REPLICA_AXIS)
-            p_chunk = jax.lax.dynamic_slice(flat_padded, (idx * chunk,),
-                                            (chunk,))
+            p_chunk = _local_chunk(flat_padded, chunk)
             return p_chunk, optimizer.init(p_chunk)
 
         jitted = jax.jit(jax.shard_map(
@@ -146,6 +172,18 @@ def make_fsdp_train_step(
             out_specs=(P(REPLICA_AXIS), _sharded_state_specs(abstract)),
             check_vma=False), donate_argnums=(0,))
         return jitted(flat)
+
+    def shard_params(params):
+        """Re-shard a full parameter pytree (same structure as the one
+        given to ``init``) without touching optimizer state — for
+        checkpoint restore or broadcast-then-reshard."""
+        flat, chunk = _capture_layout(params)
+        if chunk not in _shard_cache:
+            _shard_cache[chunk] = jax.jit(jax.shard_map(
+                lambda f: _local_chunk(f, chunk), mesh=mesh,
+                in_specs=(P(),), out_specs=P(REPLICA_AXIS),
+                check_vma=False), donate_argnums=(0,))
+        return _shard_cache[chunk](flat)
 
     def _layout():
         if not layout:
@@ -247,7 +285,8 @@ def make_fsdp_train_step(
         unravel, true_size, _ = _layout()
         return unravel(_gather(p_shard)[:true_size])
 
-    return FsdpTrainStep(init=init, step=step, full_params=full_params)
+    return FsdpTrainStep(init=init, step=step, full_params=full_params,
+                         shard_params=shard_params)
 
 
 def make_fsdp_train_step_with_state(loss_fn, optimizer, mesh=None,

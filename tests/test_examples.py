@@ -84,6 +84,14 @@ def test_resnet50_synthetic_example():
     assert "resumed from epoch 1" in out
     assert "epoch 1:" in out
     assert "checkpoint saved" in out
+    # And once more through the FSDP trainer: trainer.params' pytree
+    # property keeps the same checkpoint interoperable with fully
+    # sharded parameter storage.
+    out = _run_example("resnet50_synthetic.py",
+                       args=("--epochs", "3", "--fsdp"))
+    assert "resumed from epoch 2" in out
+    assert "epoch 2:" in out
+    assert "checkpoint saved" in out
 
 
 @pytest.mark.slow
