@@ -60,5 +60,6 @@ from .parallel.data import (  # noqa: F401
     broadcast_global_variables,
     broadcast_parameters,
 )
+from . import elastic  # noqa: F401  (hvd.elastic.State / @hvd.elastic.run)
 
 __version__ = "0.1.0"

@@ -1,0 +1,297 @@
+"""Elastic / fault-tolerant training — ``horovod_tpu.elastic``.
+
+Horovod standardized elastic training after the v0.13 snapshot this
+framework tracks (``horovod.elastic``: ``State`` objects with
+``commit``/``restore``/``sync``, an ``@hvd.elastic.run`` retry loop, and
+a driver that re-forms the Gloo ring in-process as hosts come and go).
+The v0.13 reference itself has no recovery story at all — a lost rank
+hangs the MPI job until the scheduler kills it (SURVEY.md §5 "no
+elasticity"; reference horovod/common/operations.cc:1072-1115 only
+*warns* about stalls).
+
+TPU-native redesign
+-------------------
+The Gloo-style in-process ring re-formation cannot be translated:
+``jax.distributed`` does not support re-initialization after a member is
+lost (see :func:`.core.cluster.disarm_distributed_shutdown`), and on
+real hardware a slice-membership change re-initializes the XLA runtime
+anyway.  Production TPU elasticity is checkpoint-shaped: commit state
+cheaply, let the scheduler restart the job, resume fast.  So the same
+API contract splits across the process boundary:
+
+* :class:`State` — named pytrees/scalars with ``commit()`` (every rank
+  snapshots to host memory; the coordinating process additionally
+  publishes to disk when ``HVD_TPU_ELASTIC_DIR`` is set), ``restore()``
+  (roll back to the last commit), and ``sync()`` (converge every rank on
+  the committed state via broadcast — also how a fresh incarnation picks
+  up a previous incarnation's commit).
+* :func:`run` — wraps the training function.  A collective failure
+  (``HorovodError`` — e.g. a dead peer poisoning pending ops with its
+  diagnosis) triggers rollback + reset callbacks + retry in-process when
+  the cluster is still whole, or — when the cluster lost a member and
+  cannot be re-formed — a clean ``EX_TEMPFAIL`` (75) exit that tells the
+  elastic launcher to relaunch the job from the last commit.
+* ``python -m horovod_tpu.run --elastic -np N`` — the launcher half:
+  supervises the workers, and on failure tears the job down and
+  relaunches it (bounded by ``--max-restarts``) with the commit
+  directory preserved, so ``state.sync()`` resumes training where the
+  last ``commit()`` left it.
+
+Usage::
+
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    hvd.init()
+    state = elastic.State(params=params, opt_state=opt_state,
+                          epoch=0, batch=0)
+
+    @elastic.run
+    def train(state):
+        while state.epoch < epochs:
+            for state.batch in range(state.batch, steps_per_epoch):
+                state.params, state.opt_state, loss = step(
+                    state.params, state.opt_state, batch(state))
+                if state.batch % 10 == 9:
+                    state.commit()
+            state.batch = 0
+            state.epoch += 1
+            state.commit()
+
+    train(state)
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+# The launcher interprets this exit code as "relaunch me from the last
+# commit" (BSD sysexits EX_TEMPFAIL: temporary failure, retry later).
+EX_TEMPFAIL = 75
+
+_STATE_FILE = "elastic_state.msgpack"
+
+
+def _elastic_dir() -> Optional[str]:
+    return os.environ.get("HVD_TPU_ELASTIC_DIR") or None
+
+
+def _host_copy(tree: Any) -> Any:
+    """Device→host snapshot; scalars keep their Python types."""
+    return jax.tree_util.tree_map(
+        lambda x: x if isinstance(x, (int, float, bool)) else np.asarray(x),
+        tree)
+
+
+def _cast_like(orig: Any, new: Any) -> Any:
+    """Give ``new`` back the Python scalar type ``orig`` had, so loop
+    counters survive the array round trip through broadcast/serialization
+    (``for state.batch in range(state.batch, N)`` must keep working)."""
+    if isinstance(orig, bool):
+        return bool(np.asarray(new))
+    if isinstance(orig, int) and not isinstance(orig, np.ndarray):
+        return int(np.asarray(new))
+    if isinstance(orig, float) and not isinstance(orig, np.ndarray):
+        return float(np.asarray(new))
+    return new
+
+
+class State:
+    """Committable, broadcastable training state.
+
+    ≙ ``horovod.elastic.State``/``ObjectState`` (post-v0.13): named
+    values — parameter/optimizer pytrees, loop counters — that can be
+    atomically committed, rolled back, and synchronized across ranks.
+
+    Values are attributes: ``state.params``, ``state.epoch = 3``.  New
+    values may be added after construction; they join the next commit.
+    """
+
+    def __init__(self, **values: Any) -> None:
+        object.__setattr__(self, "_values", dict(values))
+        object.__setattr__(self, "_snapshot", None)
+        object.__setattr__(self, "_reset_callbacks", [])
+        object.__setattr__(self, "_commit_serial", 0)
+        # Pre-commit snapshot so restore() before any commit() returns to
+        # the constructed state rather than failing.
+        self._snapshot_now()
+
+    # -- attribute plumbing ------------------------------------------------
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return object.__getattribute__(self, "_values")[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self._values[name] = value
+
+    # -- snapshot machinery ------------------------------------------------
+    def _snapshot_now(self) -> None:
+        object.__setattr__(self, "_snapshot", _host_copy(dict(self._values)))
+
+    def register_reset_callbacks(self, callbacks: List[Callable]) -> None:
+        """Callbacks invoked after a rollback, before retrying (≙ the
+        reference API's hook for re-building lr schedules etc. when the
+        world changed)."""
+        self._reset_callbacks.extend(callbacks)
+
+    # -- the contract ------------------------------------------------------
+    def commit(self) -> None:
+        """Atomically publish the current values as the rollback point.
+
+        Every rank keeps a host-memory snapshot; when
+        ``HVD_TPU_ELASTIC_DIR`` is set (the elastic launcher exports it)
+        the coordinating process also publishes to disk — atomic
+        write-then-rename, same discipline as
+        :func:`.utils.checkpoint.save_checkpoint` — so the commit
+        survives a full job restart.
+        """
+        self._snapshot_now()
+        object.__setattr__(self, "_commit_serial", self._commit_serial + 1)
+        d = _elastic_dir()
+        if d is None:
+            return
+        from .core import state as _state
+
+        if _state.is_initialized() and _state.process_index() != 0:
+            return
+        from flax import serialization
+
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, _STATE_FILE)
+        blob = serialization.to_bytes(self._snapshot)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    def restore(self) -> None:
+        """Roll back to the last :meth:`commit` (or the constructed
+        state).  Local only — :meth:`sync` converges ranks."""
+        snap = self._snapshot
+        vals = self._values
+        for k, committed in snap.items():
+            cur = vals.get(k, committed)
+            vals[k] = jax.tree_util.tree_map(
+                _cast_like, cur, committed) if _same_structure(
+                    cur, committed) else committed
+        # Values added after the snapshot are uncommitted: drop them.
+        for k in [k for k in vals if k not in snap]:
+            del vals[k]
+
+    def sync(self) -> None:
+        """Converge every rank on the committed state.
+
+        Order of truth: a disk commit from a previous incarnation (the
+        elastic-relaunch path) if present, else the coordinating rank's
+        current values.  Either way the result is broadcast from rank 0
+        — the reference's load-on-rank-0-then-broadcast convention — and
+        becomes the new rollback point on every rank.
+        """
+        from .core import state as _state
+
+        d = _elastic_dir()
+        path = os.path.join(d, _STATE_FILE) if d else None
+        if path and os.path.exists(path) and (
+                not _state.is_initialized()
+                or _state.process_index() == 0):
+            from flax import serialization
+
+            with open(path, "rb") as f:
+                blob = f.read()
+            loaded = serialization.from_bytes(
+                _host_copy(dict(self._values)), blob)
+            for k, v in loaded.items():
+                self._values[k] = jax.tree_util.tree_map(
+                    _cast_like, self._values[k], v)
+        if _state.is_initialized() and _state.process_count() > 1:
+            from .parallel.data import broadcast_parameters
+
+            synced = broadcast_parameters(dict(self._values), root_rank=0)
+            for k, v in synced.items():
+                self._values[k] = jax.tree_util.tree_map(
+                    _cast_like, self._values[k], v)
+        self._snapshot_now()
+
+
+def _same_structure(a: Any, b: Any) -> bool:
+    return (jax.tree_util.tree_structure(a)
+            == jax.tree_util.tree_structure(b))
+
+
+def _cluster_reformable() -> bool:
+    """Can this process retry in-process, or is the job's only way
+    forward a relaunch?  A lost peer permanently disarms the
+    jax.distributed cluster (core/cluster.py); a peer-initiated shutdown
+    likewise ends the group."""
+    from .core import cluster as _cluster
+    from .core import state as _state
+
+    if _cluster._disarmed:
+        return False
+    st = _state.global_state()
+    if st.multiprocess and (st.peer_shutdown or st.shutdown):
+        return False
+    return True
+
+
+def run(func: Callable) -> Callable:
+    """Decorator making a training function elastic (≙
+    ``@hvd.elastic.run``).
+
+    ``func(state, ...)`` runs after an initial ``state.sync()`` (which
+    resumes from a previous incarnation's commit when relaunched by the
+    elastic launcher).  On ``HorovodError``:
+
+    * cluster still whole → ``state.restore()``, reset callbacks,
+      ``state.sync()``, retry (``HVD_TPU_ELASTIC_MAX_RETRIES``, default
+      3);
+    * cluster lost a member → under the elastic launcher
+      (``HVD_TPU_ELASTIC=1``) exit with ``EX_TEMPFAIL`` so the job is
+      relaunched from the last commit; otherwise re-raise.
+    """
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args: Any, **kwargs: Any) -> Any:
+        from .ops.collective import HorovodError
+
+        state.sync()
+        retries = int(os.environ.get("HVD_TPU_ELASTIC_MAX_RETRIES", "3"))
+        attempt = 0
+        while True:
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodError as e:
+                if not _cluster_reformable():
+                    if os.environ.get("HVD_TPU_ELASTIC"):
+                        print(
+                            "horovod_tpu.elastic: collective failure with "
+                            f"an unrecoverable cluster ({e}); exiting "
+                            f"EX_TEMPFAIL({EX_TEMPFAIL}) for the elastic "
+                            "launcher to relaunch from the last commit.",
+                            file=sys.stderr, flush=True)
+                        sys.exit(EX_TEMPFAIL)
+                    raise
+                attempt += 1
+                if attempt > retries:
+                    raise
+                print(
+                    f"horovod_tpu.elastic: retrying after {e} "
+                    f"(attempt {attempt}/{retries}); rolling back to the "
+                    "last commit.", file=sys.stderr, flush=True)
+                state.restore()
+                for cb in state._reset_callbacks:
+                    cb()
+                state.sync()
+
+    return wrapper

@@ -125,9 +125,9 @@ def make_fsdp_train_step(
 
     # Flat layout (unravel closure, true size, chunk) is fixed by the
     # parameter structure at init()/shard_params() time; step()/
-    # full_params() read it.  Jitted slicers cached by chunk size.
+    # full_params() read it.  One builder = one structure (enforced in
+    # _capture_layout), so the jitted re-shard slicer is a single slot.
     layout: dict = {}
-    _shard_cache: dict = {}
 
     def _capture_layout(params):
         # One builder serves one parameter structure: a later pytree
@@ -178,12 +178,12 @@ def make_fsdp_train_step(
         given to ``init``) without touching optimizer state — for
         checkpoint restore or broadcast-then-reshard."""
         flat, chunk = _capture_layout(params)
-        if chunk not in _shard_cache:
-            _shard_cache[chunk] = jax.jit(jax.shard_map(
+        if "shard_fn" not in layout:
+            layout["shard_fn"] = jax.jit(jax.shard_map(
                 lambda f: _local_chunk(f, chunk), mesh=mesh,
                 in_specs=(P(),), out_specs=P(REPLICA_AXIS),
                 check_vma=False), donate_argnums=(0,))
-        return _shard_cache[chunk](flat)
+        return layout["shard_fn"](flat)
 
     def _layout():
         if not layout:

@@ -9,6 +9,15 @@ each worker's stdout/stderr is prefixed with its rank, mpirun-style.
 For multi-node jobs, run one ``python script.py`` per node under your
 scheduler with HVD_TPU_COORDINATOR / HVD_TPU_NUM_PROCESSES /
 HVD_TPU_PROCESS_ID exported — the same contract this launcher uses.
+
+``--elastic`` adds fault tolerance (≙ the post-v0.13 ``horovodrun``
+elastic mode): the launcher supervises the workers and, when the job
+fails — a worker crash, or a survivor exiting EX_TEMPFAIL(75) after
+diagnosing a dead peer — tears the job down and relaunches it, up to
+``--max-restarts`` times.  ``HVD_TPU_ELASTIC_DIR`` (exported to the
+workers) carries the committed ``horovod_tpu.elastic.State`` across
+incarnations, so training resumes from the last ``state.commit()``
+rather than from scratch.
 """
 
 from __future__ import annotations
@@ -19,7 +28,9 @@ import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
+import time
 
 
 def _free_ports(n: int) -> list:
@@ -40,19 +51,14 @@ def _pump(stream, rank: int, out) -> None:
         out.flush()
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        prog="python -m horovod_tpu.run",
-        description="Launch N cooperating horovod_tpu processes locally.")
-    ap.add_argument("-np", "--num-proc", type=int, required=True)
-    ap.add_argument("--platform", default=None,
-                    help="force a JAX platform for workers (e.g. cpu)")
-    ap.add_argument("command", nargs=argparse.REMAINDER,
-                    help="script (and args) to run in each process")
-    args = ap.parse_args(argv)
-    if not args.command:
-        ap.error("missing script to launch")
+def _launch_once(args, extra_env=None) -> int:
+    """One job incarnation: spawn N workers, forward output, wait.
 
+    Returns the first nonzero worker exit code (0 when all succeed).
+    A failed worker's surviving peers diagnose the death themselves and
+    exit (ops/transport.py failure detection); ``--grace`` bounds how
+    long the launcher waits for that before terminating stragglers.
+    """
     # Reserve a distinct port for the eager-op controller up front; the
     # rendezvous-port+1 default could land on an in-use port.
     coord_port, controller_port = _free_ports(2)
@@ -60,6 +66,7 @@ def main(argv=None) -> int:
     pumps = []
     for rank in range(args.num_proc):
         env = dict(os.environ)
+        env.update(extra_env or {})
         env["HVD_TPU_COORDINATOR"] = f"127.0.0.1:{coord_port}"
         env["HVD_TPU_CONTROLLER_PORT"] = str(controller_port)
         env["HVD_TPU_NUM_PROCESSES"] = str(args.num_proc)
@@ -82,14 +89,88 @@ def main(argv=None) -> int:
 
     rc = 0
     try:
+        deadline = None
+        # Poll EVERY worker each tick: any(...) would short-circuit at
+        # the first live process and never set returncode on the ranks
+        # behind it, so a crash behind a blocked rank 0 would go
+        # undetected and the grace window would never arm.
+        while None in [p.poll() for p in procs]:
+            if rc == 0:
+                rc = next((p.returncode for p in procs
+                           if p.returncode not in (None, 0)), 0)
+                if rc and args.grace > 0:
+                    deadline = time.monotonic() + args.grace
+            if deadline is not None and time.monotonic() > deadline:
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=10.0)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.wait()
+                break
+            time.sleep(0.2)
         for p in procs:
-            rc = p.wait() or rc
+            if p.returncode is None:
+                p.wait()
+            rc = rc or (p.returncode or 0)
     except KeyboardInterrupt:
         for p in procs:
             p.send_signal(signal.SIGTERM)
         rc = 130
     for t in pumps:
         t.join(timeout=2.0)
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.run",
+        description="Launch N cooperating horovod_tpu processes locally.")
+    ap.add_argument("-np", "--num-proc", type=int, required=True)
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform for workers (e.g. cpu)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="relaunch the job on worker failure, resuming "
+                         "from the last horovod_tpu.elastic.State commit")
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="elastic mode: maximum relaunches before giving "
+                         "up (default 3)")
+    ap.add_argument("--elastic-dir", default=None,
+                    help="directory carrying committed elastic state "
+                         "across incarnations (default: a fresh temp dir)")
+    ap.add_argument("--grace", type=float, default=60.0,
+                    help="seconds to let surviving workers diagnose a "
+                         "peer failure and exit before the launcher "
+                         "terminates them (0 disables)")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="script (and args) to run in each process")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("missing script to launch")
+
+    if not args.elastic:
+        return _launch_once(args)
+
+    elastic_dir = args.elastic_dir or tempfile.mkdtemp(
+        prefix="hvd_tpu_elastic_")
+    extra = {"HVD_TPU_ELASTIC": "1", "HVD_TPU_ELASTIC_DIR": elastic_dir}
+    for attempt in range(args.max_restarts + 1):
+        rc = _launch_once(args, extra)
+        if rc == 0:
+            return 0
+        if rc == 130:  # Ctrl+C is the user stopping the job, not a failure
+            return rc
+        if attempt == args.max_restarts:
+            print(f"[elastic] giving up after {attempt} restart(s): "
+                  f"rc={rc}", file=sys.stderr)
+            return rc
+        print(f"[elastic] job failed (rc={rc}); relaunching from the "
+              f"last commit in {elastic_dir} "
+              f"(restart {attempt + 1}/{args.max_restarts})",
+              file=sys.stderr, flush=True)
     return rc
 
 

@@ -500,6 +500,51 @@ def scenario_join(hvd):
     print(f"JOIN_OK rank={rank}")
 
 
+def scenario_elastic(hvd):
+    """Elastic relaunch across REAL processes: rank 1 dies hard at step
+    5 of the first incarnation; rank 0 diagnoses the dead peer, exits
+    EX_TEMPFAIL, and the --elastic launcher relaunches the job.  The
+    second incarnation resumes from the last commit (step 4) and must
+    converge to EXACTLY the weights of an uninterrupted run — the test
+    replays the arithmetic in numpy and compares."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import elastic
+
+    rank = hvd.rank()
+    edir = os.environ["HVD_TPU_ELASTIC_DIR"]
+    marker = os.path.join(edir, "victim_died")
+    total = 8
+
+    w_true = np.array([1.0, -2.0], dtype="float32")
+    rng = np.random.RandomState(17 + rank)
+    X = rng.normal(size=(total, 16, 2)).astype("float32")
+    y = X @ w_true
+
+    state = elastic.State(w=jnp.zeros((2,)), step=0)
+
+    @elastic.run
+    def train(state):
+        if state.step > 0:
+            print(f"ELASTIC_RESUMED rank={rank} step={state.step}")
+        while state.step < total:
+            i = state.step
+            if rank == 1 and i == 5 and not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(1)  # hard failure, no handshake
+            xb, yb = jnp.asarray(X[i]), jnp.asarray(y[i])
+            grad = 2.0 * xb.T @ (xb @ state.w - yb) / xb.shape[0]
+            grad = hvd.allreduce(grad, average=True, name=f"el.grad.{i}")
+            state.w = state.w - 0.1 * grad
+            state.step += 1
+            if state.step % 2 == 0:
+                state.commit()
+        return np.asarray(state.w)
+
+    w = train(state)
+    print(f"ELASTIC_OK rank={rank} w={w.round(6).tolist()}")
+
+
 def scenario_combo(hvd):
     """Run several NON-DESTRUCTIVE scenarios sequentially in ONE launch
     (``HVD_TPU_COMBO`` names them, comma-separated).  Every separate

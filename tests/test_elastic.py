@@ -1,0 +1,146 @@
+"""Elastic training: State commit/restore/sync and the @elastic.run
+retry loop (single-process legs; the cross-process relaunch leg lives in
+tests/test_multiprocess.py::test_elastic_relaunch_resumes_from_commit).
+
+≙ the post-v0.13 horovod.elastic contract; the v0.13 reference has no
+recovery at all (SURVEY.md §5), so all of this is beyond-parity — tested
+with the same self-verifying style as the reference's collective tests.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import elastic
+from horovod_tpu.ops.collective import HorovodError
+
+
+def test_state_attribute_roundtrip(hvd):
+    s = elastic.State(params={"w": jnp.ones((3,))}, epoch=0)
+    assert s.epoch == 0
+    s.epoch = 4
+    s.extra = "tag"  # values may be added after construction
+    assert s.epoch == 4 and s.extra == "tag"
+    with pytest.raises(AttributeError):
+        _ = s.missing
+
+
+def test_commit_restore_rolls_back_uncommitted(hvd):
+    s = elastic.State(params={"w": jnp.zeros((2,))}, batch=0)
+    s.params = {"w": jnp.full((2,), 5.0)}
+    s.batch = 7
+    s.commit()
+    # Diverge past the commit, then roll back.
+    s.params = {"w": jnp.full((2,), -1.0)}
+    s.batch = 11
+    s.transient = 123  # added after the commit: must vanish on restore
+    s.restore()
+    np.testing.assert_allclose(np.asarray(s.params["w"]), 5.0)
+    assert s.batch == 7 and isinstance(s.batch, int)
+    assert not hasattr(s, "transient")
+
+
+def test_restore_before_any_commit_returns_to_construction(hvd):
+    s = elastic.State(w=jnp.ones((2,)), epoch=3)
+    s.w = jnp.zeros((2,))
+    s.epoch = 9
+    s.restore()
+    np.testing.assert_allclose(np.asarray(s.w), 1.0)
+    assert s.epoch == 3
+
+
+def test_disk_commit_and_fresh_incarnation_sync(hvd, tmp_path, monkeypatch):
+    """commit() publishes to HVD_TPU_ELASTIC_DIR; a brand-new State (a
+    relaunched incarnation) picks the commit up via sync()."""
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DIR", str(tmp_path))
+    s = elastic.State(params={"w": jnp.full((3,), 2.5)}, epoch=6, batch=1)
+    s.commit()
+    assert (tmp_path / "elastic_state.msgpack").exists()
+
+    fresh = elastic.State(params={"w": jnp.zeros((3,))}, epoch=0, batch=0)
+    fresh.sync()
+    np.testing.assert_allclose(np.asarray(fresh.params["w"]), 2.5)
+    assert fresh.epoch == 6 and isinstance(fresh.epoch, int)
+    assert fresh.batch == 1
+    # sync() establishes the new rollback point.
+    fresh.epoch = 99
+    fresh.restore()
+    assert fresh.epoch == 6
+
+
+def test_run_retries_rollback_and_reset_callbacks(hvd):
+    """A transient HorovodError mid-function: run() rolls back to the
+    last commit, fires reset callbacks, and retries — uncommitted
+    progress is discarded exactly once."""
+    s = elastic.State(w=jnp.zeros((2,)), step=0)
+    resets = []
+    s.register_reset_callbacks([lambda: resets.append(True)])
+
+    @elastic.run
+    def train(state):
+        while state.step < 4:
+            state.w = state.w + 1.0
+            state.step += 1
+            if state.step == 3 and not resets:
+                # Uncommitted progress (step 3) must be rolled back.
+                raise HorovodError("injected transient failure")
+            state.commit()
+        return "done"
+
+    assert train(s) == "done"
+    assert resets == [True]
+    assert s.step == 4
+    # Steps 1,2 ran once; step 3's first attempt was rolled back, then
+    # 3,4 ran after the retry — the committed value is exactly 4 adds.
+    np.testing.assert_allclose(np.asarray(s.w), 4.0)
+
+
+def test_run_exhausts_retries_and_raises(hvd, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_ELASTIC_MAX_RETRIES", "2")
+    s = elastic.State(step=0)
+    attempts = []
+
+    @elastic.run
+    def train(state):
+        attempts.append(True)
+        raise HorovodError("persistent failure")
+
+    with pytest.raises(HorovodError, match="persistent"):
+        train(s)
+    assert len(attempts) == 3  # initial + 2 retries
+
+
+def test_run_non_horovod_errors_propagate_immediately(hvd):
+    s = elastic.State(step=0)
+    attempts = []
+
+    @elastic.run
+    def train(state):
+        attempts.append(True)
+        raise ValueError("user bug")
+
+    with pytest.raises(ValueError):
+        train(s)
+    assert len(attempts) == 1  # no retry for non-collective failures
+
+
+def test_run_initial_sync_resumes_from_disk(hvd, tmp_path, monkeypatch):
+    """run() syncs before the first attempt, so a relaunched job resumes
+    from the previous incarnation's commit without user code."""
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DIR", str(tmp_path))
+    prev = elastic.State(w=jnp.full((2,), 3.0), step=5)
+    prev.commit()
+
+    seen = {}
+
+    @elastic.run
+    def train(state):
+        seen["step"] = state.step
+        seen["w"] = np.asarray(state.w).copy()
+        return "ok"
+
+    assert train(elastic.State(w=jnp.zeros((2,)), step=0)) == "ok"
+    assert seen["step"] == 5
+    np.testing.assert_allclose(seen["w"], 3.0)

@@ -116,3 +116,28 @@ def test_uneven_join_example():
     assert "uneven_join: OK rank=0" in out
     assert "uneven_join: OK rank=1" in out
     assert "last_joined=1" in out
+
+
+@pytest.mark.slow
+def test_elastic_train_example(tmp_path):
+    """Elastic example under the real --elastic launcher: rank 1 dies at
+    step 5, the job relaunches and resumes from the last commit."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    env["HVD_TPU_EXAMPLE_DIE_AT"] = "5"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--platform", "cpu", "--elastic", "--max-restarts", "2",
+         "--elastic-dir", str(tmp_path),
+         os.path.join(REPO, "examples", "elastic_train.py")],
+        env=env, cwd=REPO, capture_output=True, timeout=420)
+    out = proc.stdout.decode() + proc.stderr.decode()
+    assert proc.returncode == 0, out
+    assert "elastic_train: rank 1 dying at step 5" in out
+    assert "[elastic] job failed" in out
+    assert "resumed rank=0 from committed step 4" in out
+    assert "elastic_train: OK rank=0" in out
+    assert "elastic_train: OK rank=1" in out
