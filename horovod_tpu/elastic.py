@@ -83,10 +83,22 @@ def _elastic_dir() -> Optional[str]:
 
 
 def _host_copy(tree: Any) -> Any:
-    """Device→host snapshot; scalars keep their Python types."""
+    """Device→host snapshot; scalars keep their Python types.
+
+    Always a FRESH buffer (``np.array`` copies; ``np.asarray`` would
+    alias a numpy-backed leaf, letting an in-place optimizer update
+    silently corrupt the rollback point)."""
     return jax.tree_util.tree_map(
-        lambda x: x if isinstance(x, (int, float, bool)) else np.asarray(x),
+        lambda x: x if isinstance(x, (int, float, bool)) else np.array(x),
         tree)
+
+
+def _restore_leaf(orig: Any, committed: Any) -> Any:
+    """One leaf of a rollback: committed value, re-cast to ``orig``'s
+    scalar type, copied so post-restore in-place mutation cannot reach
+    back into the snapshot."""
+    v = _cast_like(orig, committed)
+    return np.array(v) if isinstance(v, np.ndarray) else v
 
 
 def _cast_like(orig: Any, new: Any) -> Any:
@@ -183,8 +195,8 @@ class State:
         for k, committed in snap.items():
             cur = vals.get(k, committed)
             vals[k] = jax.tree_util.tree_map(
-                _cast_like, cur, committed) if _same_structure(
-                    cur, committed) else committed
+                _restore_leaf, cur, committed) if _same_structure(
+                    cur, committed) else _host_copy(committed)
         # Values added after the snapshot are uncommitted: drop them.
         for k in [k for k in vals if k not in snap]:
             del vals[k]
@@ -268,6 +280,7 @@ def run(func: Callable) -> Callable:
         state.sync()
         retries = int(os.environ.get("HVD_TPU_ELASTIC_MAX_RETRIES", "3"))
         attempt = 0
+        last_serial = -1
         while True:
             try:
                 return func(state, *args, **kwargs)
@@ -282,6 +295,12 @@ def run(func: Callable) -> Callable:
                             file=sys.stderr, flush=True)
                         sys.exit(EX_TEMPFAIL)
                     raise
+                if state._commit_serial > last_serial and last_serial >= 0:
+                    # Committed progress since the previous incident: the
+                    # retry budget bounds consecutive failures of ONE
+                    # incident, not the job's lifetime.
+                    attempt = 0
+                last_serial = state._commit_serial
                 attempt += 1
                 if attempt > retries:
                     raise

@@ -144,3 +144,58 @@ def test_run_initial_sync_resumes_from_disk(hvd, tmp_path, monkeypatch):
     assert train(elastic.State(w=jnp.zeros((2,)), step=0)) == "ok"
     assert seen["step"] == 5
     np.testing.assert_allclose(seen["w"], 3.0)
+
+
+def test_commit_snapshot_is_isolated_from_inplace_mutation(hvd):
+    """The rollback point must be a fresh buffer: an in-place numpy
+    update after commit() (e.g. a torch/numpy optimizer step) must not
+    reach back into the snapshot — and post-restore mutation must not
+    corrupt it either."""
+    w = np.zeros(2, dtype="float32")
+    s = elastic.State(w=w)
+    s.commit()
+    w += 1.0  # in-place: the committed copy must still be zeros
+    s.restore()
+    np.testing.assert_allclose(np.asarray(s.w), 0.0)
+    restored = s.w
+    restored += 5.0  # mutate the restored value in place
+    s.restore()      # the snapshot must be unaffected
+    np.testing.assert_allclose(np.asarray(s.w), 0.0)
+
+
+def test_retry_budget_resets_after_committed_progress(hvd, monkeypatch):
+    """HVD_TPU_ELASTIC_MAX_RETRIES bounds consecutive failures of one
+    incident; a long job with committed progress between incidents must
+    survive more total failures than the budget."""
+    monkeypatch.setenv("HVD_TPU_ELASTIC_MAX_RETRIES", "1")
+    s = elastic.State(step=0)
+    failures = []
+
+    @elastic.run
+    def train(state):
+        while state.step < 4:
+            state.step += 1
+            state.commit()
+            # One transient failure after EVERY committed step: 4
+            # incidents total, far over the budget of 1 — but each is a
+            # fresh incident, so the job must complete.
+            if len(failures) < state.step:
+                failures.append(state.step)
+                raise HorovodError("transient")
+        return state.step
+
+    assert train(s) == 4
+    assert failures == [1, 2, 3, 4]
+
+    # Without progress, the budget still bounds consecutive failures.
+    s2 = elastic.State(step=0)
+    tries = []
+
+    @elastic.run
+    def never(state):
+        tries.append(True)
+        raise HorovodError("stuck")
+
+    with pytest.raises(HorovodError):
+        never(s2)
+    assert len(tries) == 2  # initial + 1 retry
