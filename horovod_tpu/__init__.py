@@ -38,7 +38,13 @@ from .core.state import (  # noqa: F401
     size,
 )
 from .ops.collective import (  # noqa: F401
+    Adasum,
+    Average,
     HorovodError,
+    Max,
+    Min,
+    Product,
+    Sum,
     allgather,
     allgather_async,
     allreduce,
@@ -52,6 +58,7 @@ from .ops.collective import (  # noqa: F401
     shard,
     synchronize,
 )
+from .ops.wire import ReduceOp  # noqa: F401
 from .ops.compression import Compression  # noqa: F401
 from .ops.objects import allgather_object, broadcast_object  # noqa: F401
 from .ops.sparse import IndexedSlices  # noqa: F401

@@ -98,13 +98,13 @@ def _from_numpy(arr, dtype: torch.dtype) -> torch.Tensor:
     return torch.from_numpy(np.ascontiguousarray(arr)).to(dtype)
 
 
-def _enqueue(op: str, tensor: torch.Tensor, *, inplace: bool,
+def _enqueue(kind: str, tensor: torch.Tensor, *, inplace: bool,
              name: Optional[str], compression=None, **kw) -> int:
     arr = _to_numpy(tensor)
     ctx = None
     if compression is not None:
         arr, ctx = compression.compress(arr)
-    fn = getattr(_C, f"{op}_async")
+    fn = getattr(_C, f"{kind}_async")
     handle = fn(arr, name=name, **kw)
     _inplace_targets[handle] = _Pending(tensor if inplace else None,
                                         tensor.dtype, compression, ctx)
@@ -201,71 +201,75 @@ def synchronize(handle: int) -> torch.Tensor:
 
 # -- allreduce --------------------------------------------------------------
 
-def allreduce_async(tensor, average: bool = True,
-                    name: Optional[str] = None, compression=None) -> int:
+def allreduce_async(tensor, average=None, name: Optional[str] = None,
+                    compression=None, op=None) -> int:
     return _enqueue("allreduce", tensor, inplace=False, name=name,
-                    compression=compression, average=average)
+                    compression=compression, average=average, op=op)
 
 
-def allreduce_async_(tensor, average: bool = True,
-                     name: Optional[str] = None, compression=None) -> int:
+def allreduce_async_(tensor, average=None, name: Optional[str] = None,
+                     compression=None, op=None) -> int:
     return _enqueue("allreduce", tensor, inplace=True, name=name,
-                    compression=compression, average=average)
+                    compression=compression, average=average, op=op)
 
 
-def allreduce(tensor, average: bool = True, name: Optional[str] = None,
-              compression=None) -> torch.Tensor:
+def allreduce(tensor, average=None, name: Optional[str] = None,
+              compression=None, op=None) -> torch.Tensor:
     """``compression`` (``hvd.Compression.fp16``/``bf16``) casts the
-    tensor down for the wire and restores its dtype after — the kwarg
-    contract Horovod later standardized for this API."""
-    return synchronize(allreduce_async(tensor, average, name, compression))
+    tensor down for the wire and restores its dtype after; ``op`` takes
+    hvd.Average/Sum/Adasum/Min/Max/Product and supersedes ``average`` —
+    both kwarg contracts Horovod later standardized for this API."""
+    return synchronize(allreduce_async(tensor, average, name, compression,
+                                       op))
 
 
-def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
-               compression=None) -> torch.Tensor:
-    return synchronize(allreduce_async_(tensor, average, name, compression))
+def allreduce_(tensor, average=None, name: Optional[str] = None,
+               compression=None, op=None) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average, name, compression,
+                                        op))
 
 
-def _grouped_allreduce_async(tensors, *, inplace: bool, average: bool,
-                             name: Optional[str], compression) -> list:
+def _grouped_allreduce_async(tensors, *, inplace: bool, average,
+                             name: Optional[str], compression,
+                             op=None) -> list:
     """Shared body of the four grouped entry points: per-call-unique
     base name (overlapping anonymous groups must not collide), one
     handle per tensor, back-to-back enqueue so the fusion queue batches
     the group (≙ the post-v0.13 hvd.grouped_allreduce API)."""
     base = name or _C._auto_name("grouped.allreduce")
     return [_enqueue("allreduce", t, inplace=inplace, name=f"{base}.{i}",
-                     compression=compression, average=average)
+                     compression=compression, average=average, op=op)
             for i, t in enumerate(tensors)]
 
 
-def grouped_allreduce_async(tensors, average: bool = True,
+def grouped_allreduce_async(tensors, average=None,
                             name: Optional[str] = None,
-                            compression=None) -> list:
+                            compression=None, op=None) -> list:
     return _grouped_allreduce_async(tensors, inplace=False,
                                     average=average, name=name,
-                                    compression=compression)
+                                    compression=compression, op=op)
 
 
-def grouped_allreduce(tensors, average: bool = True,
+def grouped_allreduce(tensors, average=None,
                       name: Optional[str] = None,
-                      compression=None) -> list:
+                      compression=None, op=None) -> list:
     return [synchronize(h) for h in grouped_allreduce_async(
-        tensors, average, name, compression)]
+        tensors, average, name, compression, op)]
 
 
-def grouped_allreduce_async_(tensors, average: bool = True,
+def grouped_allreduce_async_(tensors, average=None,
                              name: Optional[str] = None,
-                             compression=None) -> list:
+                             compression=None, op=None) -> list:
     return _grouped_allreduce_async(tensors, inplace=True,
                                     average=average, name=name,
-                                    compression=compression)
+                                    compression=compression, op=op)
 
 
-def grouped_allreduce_(tensors, average: bool = True,
+def grouped_allreduce_(tensors, average=None,
                        name: Optional[str] = None,
-                       compression=None) -> list:
+                       compression=None, op=None) -> list:
     return [synchronize(h) for h in grouped_allreduce_async_(
-        tensors, average, name, compression)]
+        tensors, average, name, compression, op)]
 
 
 # -- allgather --------------------------------------------------------------

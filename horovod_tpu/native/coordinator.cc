@@ -160,6 +160,30 @@ class Coordinator {
         }
       }
     }
+    // Reduce-op agreement (post-v0.13 hvd op= API; v0.13 hard-codes
+    // MPI_SUM).  Must stay message-identical with ops/coordinator.py.
+    if (error.empty() && op == RequestType::kAllreduce) {
+      for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
+        const Request& r = p.requests[i];
+        if (r.reduce_op != first.reduce_op) {
+          std::ostringstream os;
+          os << "Mismatched reduce operations: One rank specified reduce op "
+             << ReduceOpName(first.reduce_op)
+             << ", but another rank specified reduce op "
+             << ReduceOpName(r.reduce_op) << ".";
+          error = os.str();
+        }
+      }
+      if (error.empty() && static_cast<int>(p.requests.size()) < size_ &&
+          first.reduce_op != ReduceOp::kSum &&
+          first.reduce_op != ReduceOp::kAverage) {
+        std::ostringstream os;
+        os << "Allreduce with reduce op " << ReduceOpName(first.reduce_op)
+           << " cannot complete after a rank has joined: a joined rank's "
+           << "zero contribution is only an identity for sum/average.";
+        error = os.str();
+      }
+    }
     if (error.empty() && op == RequestType::kAllgather) {
       if (first.tensor_shape.empty()) {
         error = "Rank zero tried to gather a rank-zero tensor.";
@@ -264,6 +288,7 @@ class Coordinator {
     switch (op) {
       case RequestType::kAllreduce:
         resp.response_type = ResponseType::kAllreduce;
+        resp.reduce_op = first.reduce_op;
         break;
       case RequestType::kAllgather:
         resp.response_type = ResponseType::kAllgather;
@@ -307,7 +332,10 @@ class Coordinator {
     withdrawn_.clear();
     for (size_t i = 0; i < responses.size(); ++i) {
       Response r = std::move(responses[i]);
-      if (r.response_type != ResponseType::kAllreduce) {
+      // Adasum never fuses: its dot products are per-tensor scale
+      // adaptations, not elementwise reductions.
+      if (r.response_type != ResponseType::kAllreduce ||
+          r.reduce_op == ReduceOp::kAdasum) {
         fused.push_back(std::move(r));
         continue;
       }
@@ -321,7 +349,8 @@ class Coordinator {
                                   : nxt.tensor_names[0]);
         int64_t nbytes = nit == sizes.end() ? 0 : nit->second;
         if (nxt.response_type == ResponseType::kAllreduce &&
-            nxt.devices == r.devices && !nxt.tensor_names.empty() &&
+            nxt.devices == r.devices && nxt.reduce_op == r.reduce_op &&
+            !nxt.tensor_names.empty() &&
             dtype_by_name_[nxt.tensor_names[0]] == dt &&
             total + nbytes <= fusion_threshold_) {
           r.tensor_names.push_back(nxt.tensor_names[0]);

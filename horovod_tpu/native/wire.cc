@@ -21,6 +21,18 @@ bool ReadLE(const uint8_t* buf, size_t len, size_t* off, T* out) {
 
 }  // namespace
 
+const char* ReduceOpName(ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kAverage: return "average";
+    case ReduceOp::kSum: return "sum";
+    case ReduceOp::kAdasum: return "adasum";
+    case ReduceOp::kMin: return "min";
+    case ReduceOp::kMax: return "max";
+    case ReduceOp::kProduct: return "product";
+  }
+  return "unknown";
+}
+
 const char* DataTypeName(DataType t) {
   switch (t) {
     case DataType::kUint8: return "uint8";
@@ -47,6 +59,7 @@ std::string Request::Pack() const {
   Append<int32_t>(&out, request_rank);
   Append<int32_t>(&out, root_rank);
   Append<int32_t>(&out, device);
+  Append<uint8_t>(&out, static_cast<uint8_t>(reduce_op));
   Append<uint16_t>(&out, static_cast<uint16_t>(tensor_name.size()));
   out.append(tensor_name);
   Append<uint8_t>(&out, static_cast<uint8_t>(tensor_shape.size()));
@@ -56,13 +69,14 @@ std::string Request::Pack() const {
 
 ssize_t Request::Unpack(const uint8_t* buf, size_t len, Request* out) {
   size_t off = 0;
-  uint8_t rt, tt, ndim;
+  uint8_t rt, tt, rop, ndim;
   uint16_t nlen;
   if (!ReadLE(buf, len, &off, &rt)) return -1;
   if (!ReadLE(buf, len, &off, &tt)) return -1;
   if (!ReadLE(buf, len, &off, &out->request_rank)) return -1;
   if (!ReadLE(buf, len, &off, &out->root_rank)) return -1;
   if (!ReadLE(buf, len, &off, &out->device)) return -1;
+  if (!ReadLE(buf, len, &off, &rop)) return -1;
   if (!ReadLE(buf, len, &off, &nlen)) return -1;
   if (off + nlen > len) return -1;
   out->tensor_name.assign(reinterpret_cast<const char*>(buf + off), nlen);
@@ -76,6 +90,7 @@ ssize_t Request::Unpack(const uint8_t* buf, size_t len, Request* out) {
   }
   out->request_type = static_cast<RequestType>(rt);
   out->tensor_type = static_cast<DataType>(tt);
+  out->reduce_op = static_cast<ReduceOp>(rop);
   return static_cast<ssize_t>(off);
 }
 
@@ -100,6 +115,7 @@ std::string Response::Pack() const {
     Append<uint8_t>(&out, static_cast<uint8_t>(shape.size()));
     for (int64_t d : shape) Append<int64_t>(&out, d);
   }
+  Append<uint8_t>(&out, static_cast<uint8_t>(reduce_op));
   return out;
 }
 

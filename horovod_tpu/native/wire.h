@@ -30,6 +30,13 @@ enum class ResponseType : uint8_t { kAllreduce = 0, kAllgather = 1,
                                     kBroadcast = 2, kError = 3, kDone = 4,
                                     kShutdown = 5, kJoin = 6 };
 
+// Allreduce reduction operator (post-v0.13 Horovod op= API; the v0.13
+// reference hard-codes MPI_SUM).  ≙ ops/wire.py ReduceOp.
+enum class ReduceOp : uint8_t { kAverage = 0, kSum = 1, kAdasum = 2,
+                                kMin = 3, kMax = 4, kProduct = 5 };
+
+const char* ReduceOpName(ReduceOp op);
+
 constexpr int kCpuDeviceId = -1;  // ≙ CPU_DEVICE_ID (common.h:28)
 
 // ≙ MPIRequest (mpi_message.h:43-85).
@@ -39,6 +46,8 @@ struct Request {
   int32_t request_rank;
   int32_t root_rank;
   int32_t device;
+  // ALLREDUCE only; coordinator-validated for cross-rank agreement.
+  ReduceOp reduce_op = ReduceOp::kAverage;
   std::string tensor_name;
   std::vector<int64_t> tensor_shape;
 
@@ -60,6 +69,8 @@ struct Response {
   // and per-fused-tensor shapes, for joined ranks' zero contributions.
   int tensor_type = -1;
   std::vector<std::vector<int64_t>> tensor_shapes;
+  // ALLREDUCE: validated reduction operator (fusion is homogeneous in it).
+  ReduceOp reduce_op = ReduceOp::kAverage;
 
   std::string Pack() const;
 };

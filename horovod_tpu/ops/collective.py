@@ -38,6 +38,7 @@ reference: docs/tensor-fusion.md, operations.cc:1328-1374) when drained.
 from __future__ import annotations
 
 import functools
+import math
 import sys
 import threading
 from dataclasses import dataclass, field
@@ -51,7 +52,25 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
 from . import wire
-from .wire import Request, RequestType, Response, ResponseType
+from .wire import ReduceOp, Request, RequestType, Response, ResponseType
+
+# Public reduction-operator constants (≙ the post-v0.13 hvd.Average /
+# hvd.Sum / hvd.Adasum / hvd.Min / hvd.Max / hvd.Product; the v0.13
+# reference hard-codes MPI_SUM + the average divide).
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+# Kernel-table prefix per reduce op ("psum" kernels serve both SUM and
+# AVERAGE — average is a post-divide, reference mpi_ops.cc:57-62).
+_OP_KERNEL = {
+    ReduceOp.SUM: "psum", ReduceOp.AVERAGE: "psum",
+    ReduceOp.MIN: "pmin", ReduceOp.MAX: "pmax",
+    ReduceOp.PRODUCT: "pprod", ReduceOp.ADASUM: "adasum",
+}
 
 
 class HorovodError(RuntimeError):
@@ -280,7 +299,69 @@ def _build_kernels(mesh):
         contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
         return jax.lax.psum(contrib, REPLICA_AXIS)
 
+    n = mesh.shape[REPLICA_AXIS]
+
+    def _prod_all(x):
+        # No lax.pprod exists: gather every contribution and reduce
+        # locally (XLA fuses the pointwise product into the gather's
+        # consumer).
+        return jnp.prod(jax.lax.all_gather(x, REPLICA_AXIS, axis=0), axis=0)
+
+    def _adasum_ladder(x):
+        """Adasum recursive-doubling ladder over the mesh axis.
+
+        The post-v0.13 Horovod Adasum operator (scale-insensitive
+        gradient combining, arXiv:2006.02924): for a pair (a, b),
+        ``adasum(a,b) = (1 - a·b/2||a||²) a + (1 - a·b/2||b||²) b``,
+        applied log2(n) times at doubling distances so every replica
+        ends with the full combination — expressed TPU-natively as
+        ``ppermute`` exchange rounds on ICI (instead of the reference
+        era's MPI recursive halving).  The formula is symmetric, so
+        both partners compute bit-identical results with no extra
+        agreement round.  Requires power-of-two n (checked at enqueue).
+        """
+        shape = x.shape
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        v = x.reshape(-1).astype(acc)
+        for r in range(int(math.log2(n))):
+            dist = 1 << r
+            perm = [(i, i ^ dist) for i in range(n)]
+            other = jax.lax.ppermute(v, REPLICA_AXIS, perm)
+            dot = jnp.sum(v * other)
+            na = jnp.sum(v * v)
+            nb = jnp.sum(other * other)
+            ca = 1.0 - jnp.where(na > 0, dot / (2.0 * na), 0.0)
+            cb = 1.0 - jnp.where(nb > 0, dot / (2.0 * nb), 0.0)
+            v = ca * v + cb * other
+        return v.astype(x.dtype).reshape(shape)
+
+    def _pr_block(fn):
+        # Per-replica [size, ...] layout: reduce this replica's squeezed
+        # shard, emit one identical row per replica.
+        def body(x):
+            return fn(jnp.squeeze(x, axis=0))[None]
+        return body
+
+    extra = {}
+    for key, fn in (("pmin", lambda x: jax.lax.pmin(x, REPLICA_AXIS)),
+                    ("pmax", lambda x: jax.lax.pmax(x, REPLICA_AXIS)),
+                    ("pprod", _prod_all)):
+        extra[f"{key}_pr"] = sm(_pr_block(fn), P(REPLICA_AXIS),
+                                P(REPLICA_AXIS), check_vma=False)
+        extra[f"{key}_rep"] = sm(fn, P(), P(), check_vma=False)
+        extra[f"{key}_out_rep"] = sm(
+            lambda x, fn=fn: fn(jnp.squeeze(x, axis=0)),
+            P(REPLICA_AXIS), P(), check_vma=False)
+    if n & (n - 1) == 0:  # adasum needs a power-of-two axis
+        extra["adasum_pr"] = sm(_pr_block(_adasum_ladder), P(REPLICA_AXIS),
+                                P(REPLICA_AXIS), check_vma=False)
+        extra["adasum_rep"] = sm(_adasum_ladder, P(), P(), check_vma=False)
+        extra["adasum_out_rep"] = sm(
+            lambda x: _adasum_ladder(jnp.squeeze(x, axis=0)),
+            P(REPLICA_AXIS), P(), check_vma=False)
+
     return {
+        **extra,
         # Per-replica [size, ...] -> per-replica [size, ...] (each = sum).
         "psum_pr": sm(lambda x: jax.lax.psum(x, REPLICA_AXIS),
                       P(REPLICA_AXIS), P(REPLICA_AXIS)),
@@ -383,7 +464,7 @@ class _QueuedOp:
     name: str
     op: RequestType
     contrib: _Contribution
-    average: bool
+    red_op: ReduceOp
     root_rank: int
     handle: int
     nbytes: int
@@ -451,7 +532,8 @@ def _background_loop(stop_event: threading.Event) -> None:
 
 
 def _submit_requests(name: str, op: RequestType, c: _Contribution,
-                     root_rank: int = -1) -> None:
+                     root_rank: int = -1,
+                     red_op: ReduceOp = ReduceOp.SUM) -> None:
     st = _state.global_state()
     if st.timeline is not None:
         st.timeline.negotiate_start(name, op.name)
@@ -464,7 +546,7 @@ def _submit_requests(name: str, op: RequestType, c: _Contribution,
             request_rank=st.process_index, request_type=op,
             tensor_type=wire.dtype_of(c.dtype), tensor_name=name,
             root_rank=root_rank, device=c.devices[0],
-            tensor_shape=c.shapes[0]))
+            tensor_shape=c.shapes[0], reduce_op=red_op))
         return
     coord = st.coordinator
     for r in range(st.size):
@@ -472,7 +554,7 @@ def _submit_requests(name: str, op: RequestType, c: _Contribution,
             request_rank=r, request_type=op,
             tensor_type=wire.dtype_of(c.dtype), tensor_name=name,
             root_rank=root_rank, device=c.devices[r],
-            tensor_shape=c.shapes[r]))
+            tensor_shape=c.shapes[r], reduce_op=red_op))
 
 
 def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
@@ -523,18 +605,20 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
     if resp.response_type == ResponseType.ALLREDUCE:
         ks = _mesh_kernels()
         # Sub-group by layout: per-replica vs replicated inputs reduce with
-        # different shardings and cannot share one flat buffer.
+        # different shardings and cannot share one flat buffer.  The group
+        # is homogeneous in red_op (the coordinator fuses like-op only).
         for layout in (True, False):
             group = [o for o in ops if o.contrib.per_replica == layout]
             if not group:
                 continue
-            kernel = ks["psum_pr"] if layout else ks["psum_rep"]
+            kernel = ks[_OP_KERNEL[group[0].red_op]
+                        + ("_pr" if layout else "_rep")]
             if len(group) == 1:
                 o = group[0]
                 if tl: tl.start(o.name, "ALLREDUCE")
                 if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
                 out = kernel(o.contrib.value)
-                if o.average:
+                if o.red_op == ReduceOp.AVERAGE:
                     out = _divide(out, st.size)
                 if tl: tl.activity_end(o.name)
                 if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
@@ -567,7 +651,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
                 else:
                     piece = red[offs:offs + n].reshape(o.contrib.shapes[0])
                 offs += n
-                if o.average:
+                if o.red_op == ReduceOp.AVERAGE:
                     piece = _divide(piece, st.size)
                 if tl: tl.activity_end(o.name)
                 if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
@@ -666,14 +750,17 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
             o = ops[0]
             if tl: tl.start(o.name, "ALLREDUCE")
             if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
-            out = ks["psum_out_rep"](_mp_global(o.contrib.value))
-            if o.average:
+            out = ks[_OP_KERNEL[o.red_op] + "_out_rep"](
+                _mp_global(o.contrib.value))
+            if o.red_op == ReduceOp.AVERAGE:
                 out = _divide(out, st.process_count)
             if tl: tl.activity_end(o.name)
             if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
             hm._get(o.handle).result = out
             return
         # Fused: one flat buffer per response (≙ MEMCPY_IN_FUSION_BUFFER).
+        # Homogeneous in red_op — the coordinator fuses like-op only (and
+        # never fuses adasum, whose dots are per-tensor).
         for o in ops:
             if tl: tl.start(o.name, "ALLREDUCE")
             if tl: tl.activity_start(o.name, "MEMCPY_IN_FUSION_BUFFER")
@@ -681,7 +768,7 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
         for o in ops:
             if tl: tl.activity_end(o.name)
             if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
-        red = ks["psum_out_rep"](_mp_global(buf))
+        red = ks[_OP_KERNEL[ops[0].red_op] + "_out_rep"](_mp_global(buf))
         offs = 0
         for o in ops:
             n = int(np.prod(o.contrib.shapes[0], dtype=np.int64)) if \
@@ -690,7 +777,7 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
             if tl: tl.activity_start(o.name, "MEMCPY_OUT_FUSION_BUFFER")
             piece = red[offs:offs + n].reshape(o.contrib.shapes[0])
             offs += n
-            if o.average:
+            if o.red_op == ReduceOp.AVERAGE:
                 piece = _divide(piece, st.process_count)
             if tl: tl.activity_end(o.name)
             if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
@@ -759,9 +846,11 @@ def _execute_response_mp_joined(resp: Response,
             o = by_name.get(resp.tensor_names[0])
             val = o.contrib.value if o is not None \
                 else jnp.zeros(shapes[0], dtype)
+            # Only SUM/AVERAGE can reach a joined rank (the coordinator
+            # errors other reduce ops once a rank has joined).
             out = ks["psum_out_rep"](_mp_global(val))
             if o is not None:
-                if o.average:
+                if o.red_op == ReduceOp.AVERAGE:
                     out = _divide(out, st.process_count)
                 hm._get(o.handle).result = out
             return
@@ -777,7 +866,7 @@ def _execute_response_mp_joined(resp: Response,
             cnt = numel(s)
             if o is not None:
                 piece = red[offs:offs + cnt].reshape(s)
-                if o.average:
+                if o.red_op == ReduceOp.AVERAGE:
                     piece = _divide(piece, st.process_count)
                 hm._get(o.handle).result = piece
             offs += cnt
@@ -892,13 +981,52 @@ def _drain() -> None:
 # Public API
 # ---------------------------------------------------------------------------
 
-def _enqueue(x, op: RequestType, name: Optional[str], average: bool = False,
+def _resolve_op(average, op) -> ReduceOp:
+    """Resolve the (average, op) pair into one ReduceOp.
+
+    Mirrors the post-v0.13 Horovod contract: ``op`` supersedes
+    ``average`` and passing both is an error; with neither, the default
+    is Average (the reference's allreduce default,
+    tensorflow/__init__.py:49, torch/mpi_ops.py:58)."""
+    if op is not None:
+        if average is not None:
+            raise ValueError(
+                "allreduce: specify either average= or op=, not both "
+                "(op supersedes average).")
+        return ReduceOp(op)
+    if average is None or average:
+        return ReduceOp.AVERAGE
+    return ReduceOp.SUM
+
+
+def _check_reduce_op(red_op: ReduceOp, dtype) -> None:
+    st = _state.global_state()
+    if red_op == ReduceOp.ADASUM:
+        n = _state.contributor_count()
+        if n & (n - 1) != 0:
+            raise ValueError(
+                f"op=Adasum requires a power-of-two contributor count for "
+                f"its recursive-doubling ppermute ladder; got {n}.")
+        if not jnp.issubdtype(jnp.dtype(dtype), jnp.inexact):
+            raise ValueError(
+                f"op=Adasum is defined on floating-point gradients; got "
+                f"dtype {dtype}.")
+        if st.joining:
+            raise HorovodError(
+                "op=Adasum cannot run while this rank has joined: a zero "
+                "contribution is only an identity for sum/average.")
+
+
+def _enqueue(x, op: RequestType, name: Optional[str],
+             red_op: ReduceOp = ReduceOp.SUM,
              root_rank: int = -1, prefix: str = "") -> int:
     _state._check_initialized()
     st = _state.global_state()
     if st.peer_shutdown:
         raise HorovodError(SHUT_DOWN_ERROR_MESSAGE)
     c = _classify(x, op)
+    if op == RequestType.ALLREDUCE:
+        _check_reduce_op(red_op, c.dtype)
     name = name or _auto_name(prefix or op.name.lower())
     # Payload bytes of ONE replica's tensor — the quantity the reference's
     # fusion accounting uses (tensor->size(), operations.cc:1341-1352).
@@ -906,24 +1034,27 @@ def _enqueue(x, op: RequestType, name: Optional[str], average: bool = False,
     s0 = c.shapes[0]
     nbytes = int(np.prod(s0, dtype=np.int64)) * item if s0 else item
     handle = st.handle_manager.allocate(None, name=name)
-    _queue.put(_QueuedOp(name=name, op=op, contrib=c, average=average,
+    _queue.put(_QueuedOp(name=name, op=op, contrib=c, red_op=red_op,
                          root_rank=root_rank, handle=handle, nbytes=nbytes))
-    _submit_requests(name, op, c, root_rank)
+    _submit_requests(name, op, c, root_rank, red_op=red_op)
     return handle
 
 
-def allreduce_async(tensor, average: bool = True,
-                    name: Optional[str] = None) -> int:
+def allreduce_async(tensor, average=None, name: Optional[str] = None,
+                    op=None) -> int:
     """Queue an allreduce; returns a handle for poll/synchronize
     (≙ horovod_torch_allreduce_async_*, torch/mpi_ops.cc:206-253).
-    ``average`` defaults to True for parity with the reference API
-    (torch/mpi_ops.py:58, tensorflow/__init__.py:49)."""
-    return _enqueue(tensor, RequestType.ALLREDUCE, name, average=average,
-                    prefix="allreduce")
+    Averages by default for parity with the reference API
+    (torch/mpi_ops.py:58, tensorflow/__init__.py:49); ``op`` takes any
+    of hvd.Average/Sum/Adasum/Min/Max/Product (the post-v0.13 API) and
+    supersedes ``average``."""
+    return _enqueue(tensor, RequestType.ALLREDUCE, name,
+                    red_op=_resolve_op(average, op), prefix="allreduce")
 
 
-def grouped_allreduce_async(tensors, average: bool = True,
-                            name: Optional[str] = None) -> List[int]:
+def grouped_allreduce_async(tensors, average=None,
+                            name: Optional[str] = None,
+                            op=None) -> List[int]:
     """Queue a group of allreduces in one call; returns one handle per
     tensor (≙ the post-v0.13 hvd.grouped_allreduce API).  The group
     enters the request queue back-to-back, so Tensor Fusion batches it
@@ -932,19 +1063,20 @@ def grouped_allreduce_async(tensors, average: bool = True,
     batching, never results.  The default base name is unique per call
     so overlapping anonymous groups never collide."""
     base = name or _auto_name("grouped.allreduce")
+    red_op = _resolve_op(average, op)
     return [
-        _enqueue(t, RequestType.ALLREDUCE, f"{base}.{i}", average=average,
+        _enqueue(t, RequestType.ALLREDUCE, f"{base}.{i}", red_op=red_op,
                  prefix="allreduce")
         for i, t in enumerate(tensors)
     ]
 
 
-def grouped_allreduce(tensors, average: bool = True,
-                      name: Optional[str] = None) -> List:
+def grouped_allreduce(tensors, average=None, name: Optional[str] = None,
+                      op=None) -> List:
     """Synchronous grouped allreduce: fused under the hood, one result
     per input tensor, input order preserved."""
     return [synchronize(h)
-            for h in grouped_allreduce_async(tensors, average, name)]
+            for h in grouped_allreduce_async(tensors, average, name, op)]
 
 
 def allgather_async(tensor, name: Optional[str] = None) -> int:
@@ -1038,10 +1170,12 @@ def synchronize(handle: int):
     return st.handle_manager.synchronize(handle)
 
 
-def allreduce(tensor, average: bool = True, name: Optional[str] = None):
+def allreduce(tensor, average=None, name: Optional[str] = None, op=None):
     """Synchronous allreduce — mean by default, sum with ``average=False``
     (defaults match the reference: tensorflow/__init__.py:49,
-    torch/mpi_ops.py:58).
+    torch/mpi_ops.py:58), or any reduction via ``op`` —
+    hvd.Average/Sum/Adasum/Min/Max/Product (the post-v0.13 API; ``op``
+    supersedes ``average``).
 
     :class:`~horovod_tpu.ops.sparse.IndexedSlices` inputs dispatch to the
     sparse gather-of-(values, indices) path transparently, exactly like
@@ -1052,8 +1186,17 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
     if isinstance(tensor, _sparse.IndexedSlices) or (
             isinstance(tensor, (list, tuple)) and tensor
             and all(isinstance(t, _sparse.IndexedSlices) for t in tensor)):
-        return _sparse.allreduce(tensor, average=average, name=name)
-    return synchronize(allreduce_async(tensor, average=average, name=name))
+        red = _resolve_op(average, op)
+        if red not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+            raise ValueError(
+                f"sparse (IndexedSlices) allreduce supports only "
+                f"sum/average — it is a gather of (values, indices), "
+                f"reference tensorflow/__init__.py:67-78; got op="
+                f"{wire.reduce_op_name(red)}.")
+        return _sparse.allreduce(tensor, average=red == ReduceOp.AVERAGE,
+                                 name=name)
+    return synchronize(allreduce_async(tensor, average=average, name=name,
+                                       op=op))
 
 
 def allgather(tensor, name: Optional[str] = None):

@@ -190,6 +190,27 @@ class PyCoordinator:
                              f"sent a tensor of shape "
                              f"{list(r.tensor_shape)}.")
                     break
+        # Allreduce: reduce-op agreement (post-v0.13 hvd op= API; no
+        # reference analogue — v0.13 hard-codes MPI_SUM).
+        if error is None and op == RequestType.ALLREDUCE:
+            for r in reqs[1:]:
+                if r.reduce_op != first.reduce_op:
+                    error = (f"Mismatched reduce operations: One rank "
+                             f"specified reduce op "
+                             f"{wire.reduce_op_name(first.reduce_op)}, but "
+                             f"another rank specified reduce op "
+                             f"{wire.reduce_op_name(r.reduce_op)}.")
+                    break
+            if error is None and len(reqs) < self.size and \
+                    first.reduce_op not in (wire.ReduceOp.SUM,
+                                            wire.ReduceOp.AVERAGE):
+                # Completed via joins: a joined rank's zero contribution
+                # is only an identity for sum/average.
+                error = (f"Allreduce with reduce op "
+                         f"{wire.reduce_op_name(first.reduce_op)} cannot "
+                         f"complete after a rank has joined: a joined "
+                         f"rank's zero contribution is only an identity "
+                         f"for sum/average.")
         # Allgather: same ndim, same non-first dims (operations.cc:334-392).
         tensor_sizes: List[int] = []
         if error is None and op == RequestType.ALLGATHER:
@@ -269,7 +290,8 @@ class PyCoordinator:
         common = dict(devices=devices, tensor_type=first.tensor_type,
                       tensor_shapes=[tuple(first.tensor_shape)])
         if op == RequestType.ALLREDUCE:
-            return Response(ResponseType.ALLREDUCE, [name], **common)
+            return Response(ResponseType.ALLREDUCE, [name],
+                            reduce_op=first.reduce_op, **common)
         if op == RequestType.ALLGATHER:
             return Response(ResponseType.ALLGATHER, [name],
                             tensor_sizes=tensor_sizes, **common)
@@ -294,7 +316,10 @@ class PyCoordinator:
         while i < len(responses):
             r = responses[i]
             i += 1
-            if r.response_type != ResponseType.ALLREDUCE:
+            if r.response_type != ResponseType.ALLREDUCE \
+                    or r.reduce_op == wire.ReduceOp.ADASUM:
+                # Adasum never fuses: its dot products are per-tensor
+                # scale adaptations, not elementwise reductions.
                 fused.append(r)
                 continue
             total = sizes_bytes.get(r.tensor_names[0], 0)
@@ -304,6 +329,7 @@ class PyCoordinator:
                 nxt = responses[j]
                 if (nxt.response_type == ResponseType.ALLREDUCE
                         and nxt.devices == r.devices
+                        and nxt.reduce_op == r.reduce_op
                         and self._resp_dtype.get(nxt.tensor_names[0]) == dtype
                         and total + sizes_bytes.get(nxt.tensor_names[0], 0)
                         <= self.fusion_threshold):

@@ -115,6 +115,25 @@ class RequestType(IntEnum):
     JOIN = 3
 
 
+class ReduceOp(IntEnum):
+    """Allreduce reduction operator (the post-v0.13 Horovod ``op=``
+    API — hvd.Average/Sum/Adasum/Min/Max/Product; the v0.13 reference
+    hard-codes MPI_SUM, operations.cc:984-988).  Carried per Request so
+    the coordinator validates cross-rank agreement and fuses only
+    like-op responses."""
+
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+def reduce_op_name(op) -> str:
+    return ReduceOp(op).name.lower()
+
+
 class ResponseType(IntEnum):
     """≙ MPIResponseType (mpi_message.h) — ERROR carries a cross-replica
     validation message; DONE/SHUTDOWN close the negotiation; JOIN
@@ -154,12 +173,16 @@ class Request:
     root_rank: int = -1
     device: int = CPU_DEVICE_ID
     tensor_shape: Tuple[int, ...] = ()
+    # ALLREDUCE only (ALLGATHER/BROADCAST ignore it): the reduction
+    # operator, validated for cross-rank agreement by the coordinator.
+    reduce_op: ReduceOp = ReduceOp.AVERAGE
 
     def pack(self) -> bytes:
         name_b = self.tensor_name.encode("utf-8")
         out = struct.pack(
-            "<BBiii H", int(self.request_type), int(self.tensor_type),
-            self.request_rank, self.root_rank, self.device, len(name_b))
+            "<BBiiiBH", int(self.request_type), int(self.tensor_type),
+            self.request_rank, self.root_rank, self.device,
+            int(self.reduce_op), len(name_b))
         out += name_b
         out += struct.pack("<B", len(self.tensor_shape))
         for d in self.tensor_shape:
@@ -168,8 +191,9 @@ class Request:
 
     @staticmethod
     def unpack(buf: bytes, off: int = 0) -> Tuple["Request", int]:
-        rt, tt, rank, root, dev, nlen = struct.unpack_from("<BBiii H", buf, off)
-        off += struct.calcsize("<BBiii H")
+        rt, tt, rank, root, dev, rop, nlen = struct.unpack_from(
+            "<BBiiiBH", buf, off)
+        off += struct.calcsize("<BBiiiBH")
         name = buf[off:off + nlen].decode("utf-8")
         off += nlen
         (ndim,) = struct.unpack_from("<B", buf, off)
@@ -177,7 +201,7 @@ class Request:
         dims = struct.unpack_from(f"<{ndim}q", buf, off) if ndim else ()
         off += 8 * ndim
         return Request(rank, RequestType(rt), DataType(tt), name, root, dev,
-                       tuple(dims)), off
+                       tuple(dims), ReduceOp(rop)), off
 
 
 @dataclass
@@ -196,6 +220,9 @@ class Response:
     # its zero contributions from these.  255 on the wire = no dtype.
     tensor_type: Optional[DataType] = None
     tensor_shapes: List[Tuple[int, ...]] = field(default_factory=list)
+    # ALLREDUCE: the validated reduction operator (fusion groups are
+    # homogeneous in it; joined ranks execute from it).
+    reduce_op: ReduceOp = ReduceOp.AVERAGE
 
     def pack(self) -> bytes:
         out = struct.pack("<BH", int(self.response_type), len(self.tensor_names))
@@ -217,6 +244,7 @@ class Response:
             out += struct.pack("<B", len(shape))
             for d in shape:
                 out += struct.pack("<q", d)
+        out += struct.pack("<B", int(self.reduce_op))
         return out
 
     @staticmethod
@@ -252,8 +280,11 @@ class Response:
             dims = struct.unpack_from(f"<{ndim}q", buf, off) if ndim else ()
             off += 8 * ndim
             shapes.append(tuple(dims))
+        (rop,) = struct.unpack_from("<B", buf, off)
+        off += 1
         return Response(ResponseType(rt), names, err, devices, sizes,
-                        None if tt == 255 else DataType(tt), shapes), off
+                        None if tt == 255 else DataType(tt), shapes,
+                        ReduceOp(rop)), off
 
 
 def pack_response_list(responses: List[Response]) -> bytes:

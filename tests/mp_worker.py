@@ -62,6 +62,30 @@ def scenario_basic(hvd):
     np.testing.assert_allclose(np.asarray(as_dense(out)),
                                [[1.0, 1.0], [2.0, 2.0]])
 
+    # Reduce operators across REAL processes (post-v0.13 op= API):
+    # rank r contributes r+1, so min/max/product are all distinct; the
+    # adasum of [1,0] and [0,2] (orthogonal) is their sum; mismatched
+    # ops for one name must fail validation on both ranks.
+    import jax.numpy as _jnp
+
+    x = _jnp.array([float(rank + 1)])
+    assert float(hvd.allreduce(x, op=hvd.Min, name="red.min")[0]) == 1.0
+    assert float(hvd.allreduce(x, op=hvd.Max, name="red.max")[0]) == 2.0
+    assert float(hvd.allreduce(x, op=hvd.Product,
+                               name="red.prod")[0]) == 2.0
+    ada = hvd.allreduce(_jnp.array([1.0, 0.0]) if rank == 0
+                        else _jnp.array([0.0, 2.0]),
+                        op=hvd.Adasum, name="red.adasum")
+    np.testing.assert_allclose(np.asarray(ada), [1.0, 2.0], rtol=1e-6)
+    from horovod_tpu import HorovodError as _HErr
+
+    try:
+        hvd.allreduce(x, op=hvd.Min if rank == 0 else hvd.Max,
+                      name="red.bad")
+        raise AssertionError("mismatched reduce ops did not raise")
+    except _HErr as e:
+        assert "Mismatched reduce operations" in str(e), str(e)
+
     # Object collectives across REAL processes: per-rank pickles of
     # genuinely different sizes ride the ragged allgather; broadcast
     # ships the root's object to the non-root.

@@ -197,3 +197,101 @@ def test_grouped_allreduce_overlapping_anonymous_groups(hvd):
                                float(hvd.size()))
     np.testing.assert_allclose(np.asarray(hvd.synchronize(h2[0])),
                                2.0 * hvd.size())
+
+
+# -- Reduce operators (post-v0.13 hvd op= API; v0.13 hard-codes MPI_SUM
+# -- + the average divide) --------------------------------------------------
+
+def test_allreduce_op_min_max_product(hvd):
+    """Min/Max/Product over genuinely different per-replica values."""
+    n = hvd.size()
+    vals = jnp.arange(1.0, n + 1.0).reshape(n, 1)
+    x = hvd.shard(vals)
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x, op=hvd.Min))[0], 1.0)
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x, op=hvd.Max))[0], float(n))
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x, op=hvd.Product))[0],
+        float(np.prod(np.arange(1.0, n + 1.0))))
+    # Integer dtypes work for min/max/product (no divide involved).
+    xi = hvd.shard(jnp.arange(1, n + 1, dtype=jnp.int32).reshape(n, 1))
+    assert int(np.asarray(hvd.allreduce(xi, op=hvd.Max))[0]) == n
+
+
+def test_allreduce_op_replicated_semantics(hvd):
+    """A replicated input means every replica contributes the same value:
+    sum gives x*n, product x**n, min/max/adasum give x back."""
+    n = hvd.size()
+    x = jnp.array([2.0])
+    assert float(hvd.allreduce(x, op=hvd.Sum)[0]) == 2.0 * n
+    assert float(hvd.allreduce(x, op=hvd.Product)[0]) == 2.0 ** n
+    assert float(hvd.allreduce(x, op=hvd.Min)[0]) == 2.0
+    assert float(hvd.allreduce(x, op=hvd.Max)[0]) == 2.0
+    assert float(hvd.allreduce(x, op=hvd.Adasum)[0]) == pytest.approx(2.0)
+
+
+def _adasum_reference(vectors):
+    """Recursive-doubling Adasum in numpy (arXiv:2006.02924): the
+    executable spec the ppermute ladder must match."""
+    vs = [np.asarray(v, np.float32).ravel().astype(np.float64)
+          for v in vectors]
+    while len(vs) > 1:
+        nxt = []
+        for a, b in zip(vs[0::2], vs[1::2]):
+            dot, na, nb = a @ b, a @ a, b @ b
+            ca = 1.0 - (dot / (2.0 * na) if na > 0 else 0.0)
+            cb = 1.0 - (dot / (2.0 * nb) if nb > 0 else 0.0)
+            nxt.append(ca * a + cb * b)
+        vs = nxt
+    return vs[0]
+
+
+def test_allreduce_op_adasum_matches_reference(hvd):
+    """The ppermute ladder equals the pairwise recursive-doubling spec,
+    including orthogonal contributions (where adasum = plain sum)."""
+    n = hvd.size()
+    rng = np.random.RandomState(7)
+    vals = rng.normal(size=(n, 6)).astype(np.float32)
+    out = np.asarray(hvd.allreduce(hvd.shard(jnp.asarray(vals)),
+                                   op=hvd.Adasum))
+    want = _adasum_reference(list(vals))
+    np.testing.assert_allclose(out[0], want, rtol=1e-5)
+    # Orthogonal vectors: dots vanish, adasum degenerates to the sum.
+    eye = np.eye(n, dtype=np.float32)
+    out = np.asarray(hvd.allreduce(hvd.shard(jnp.asarray(eye)),
+                                   op=hvd.Adasum))
+    np.testing.assert_allclose(out[0], np.ones(n), rtol=1e-6)
+
+
+def test_allreduce_op_argument_validation(hvd):
+    with pytest.raises(ValueError, match="not both"):
+        hvd.allreduce(jnp.ones((2,)), average=True, op=hvd.Sum)
+    with pytest.raises(ValueError, match="floating-point"):
+        hvd.allreduce(jnp.ones((2,), jnp.int32), op=hvd.Adasum)
+    with pytest.raises(ValueError, match="sum/average"):
+        from horovod_tpu import IndexedSlices
+        sl = IndexedSlices(jnp.ones((1, 2)), jnp.array([0]), (2, 2))
+        hvd.allreduce(sl, op=hvd.Max)
+
+
+def test_adasum_requires_power_of_two(hvd):
+    """A 3-replica mesh cannot run the recursive-doubling ladder."""
+    import horovod_tpu as hvd3
+    import jax
+    hvd3.init(devices=jax.devices()[:3])
+    try:
+        with pytest.raises(ValueError, match="power-of-two"):
+            hvd3.allreduce(jnp.ones((2,)), op=hvd3.Adasum)
+    finally:
+        hvd3.init(devices=jax.devices())  # restore for the fixture
+
+
+def test_grouped_allreduce_op_kwarg(hvd):
+    """The grouped API takes op= too; a max group reduces element-max."""
+    n = hvd.size()
+    ts = [hvd.shard(jnp.arange(float(n)).reshape(n, 1)),
+          hvd.shard(jnp.arange(float(n), 0.0, -1.0).reshape(n, 1))]
+    outs = hvd.grouped_allreduce(ts, op=hvd.Max)
+    np.testing.assert_allclose(np.asarray(outs[0])[0], float(n - 1))
+    np.testing.assert_allclose(np.asarray(outs[1])[0], float(n))

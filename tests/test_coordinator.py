@@ -10,8 +10,8 @@ import pytest
 from horovod_tpu.native import lib as _native_lib
 from horovod_tpu.ops.coordinator import (NativeCoordinator, PyCoordinator,
                                          STALL_WARNING_SECONDS)
-from horovod_tpu.ops.wire import (DataType, Request, RequestType, Response,
-                                  ResponseType, pack_response_list,
+from horovod_tpu.ops.wire import (DataType, ReduceOp, Request, RequestType,
+                                  Response, ResponseType, pack_response_list,
                                   unpack_response_list)
 
 
@@ -41,8 +41,9 @@ def make_coord(request):
 
 
 def _req(rank, name, shape=(4,), op=RequestType.ALLREDUCE,
-         dtype=DataType.FLOAT32, root=-1, device=-1):
-    return Request(rank, op, dtype, name, root, device, shape)
+         dtype=DataType.FLOAT32, root=-1, device=-1,
+         red=ReduceOp.AVERAGE):
+    return Request(rank, op, dtype, name, root, device, shape, red)
 
 
 def test_readiness_counting(make_coord):
@@ -181,9 +182,10 @@ def test_py_native_response_parity_fuzz():
             # is injected explicitly to exercise the ERROR paths.
             base_shape = (int(rng.randint(1, 4)), 3)
             base_dtype = dtypes[rng.randint(len(dtypes))]
+            base_red = ReduceOp(int(rng.randint(0, 6)))
             root = int(rng.randint(0, size))
             for r in range(size):
-                shape, dt = base_shape, base_dtype
+                shape, dt, red = base_shape, base_dtype, base_red
                 if op == RequestType.ALLGATHER and rng.rand() < 0.5:
                     # Ragged dim 0 is legal for allgather (Allgatherv).
                     shape = (int(rng.randint(1, 6)), shape[1])
@@ -191,8 +193,10 @@ def test_py_native_response_parity_fuzz():
                     shape = (shape[0], 4)
                 if rng.rand() < 0.1:
                     dt = dtypes[(dtypes.index(dt) + 1) % len(dtypes)]
+                if rng.rand() < 0.1:
+                    red = ReduceOp((int(red) + 1) % 6)
                 py_req = _req(r, name, shape=shape, op=op, dtype=dt,
-                              root=root)
+                              root=root, red=red)
                 py.submit(py_req)
                 nat.submit(py_req)
         py_resps = py.poll_responses(sizes_bytes)
@@ -327,3 +331,65 @@ def test_broadcast_response_carries_root(make_coord):
     resps = c.poll_responses({"b": 16})
     assert resps[0].response_type == ResponseType.BROADCAST
     assert list(resps[0].tensor_sizes) == [1]
+
+
+def test_reduce_op_mismatch_is_error(make_coord):
+    """Ranks disagreeing on the reduce operator for one name must get
+    the ERROR response (the post-v0.13 op= API; v0.13 hard-codes
+    MPI_SUM so the case could not arise)."""
+    c = make_coord(2, 1 << 20)
+    c.submit(_req(0, "t", red=ReduceOp.SUM))
+    c.submit(_req(1, "t", red=ReduceOp.MAX))
+    (resp,) = c.poll_responses({"t": 16})
+    assert resp.response_type == ResponseType.ERROR
+    assert "Mismatched reduce operations" in resp.error_message
+    assert "sum" in resp.error_message and "max" in resp.error_message
+
+
+def test_fusion_groups_by_reduce_op(make_coord):
+    """Same-dtype same-device allreduces with DIFFERENT reduce ops must
+    not share a fusion buffer (a min cannot ride a sum reduction)."""
+    c = make_coord(2, 1 << 20)
+    for name, red in (("a", ReduceOp.SUM), ("b", ReduceOp.MAX),
+                      ("c", ReduceOp.SUM)):
+        for r in range(2):
+            c.submit(_req(r, name, red=red))
+    resps = c.poll_responses({"a": 16, "b": 16, "c": 16})
+    groups = sorted(sorted(r.tensor_names) for r in resps)
+    assert groups == [["a", "c"], ["b"]], groups
+    by_first = {r.tensor_names[0]: r.reduce_op for r in resps}
+    assert by_first["a"] == ReduceOp.SUM
+    assert by_first["b"] == ReduceOp.MAX
+
+
+def test_adasum_never_fuses(make_coord):
+    """Adasum responses stay un-fused: the dot products are per-tensor
+    scale adaptations, not elementwise reductions."""
+    c = make_coord(2, 1 << 20)
+    for name in ("a", "b"):
+        for r in range(2):
+            c.submit(_req(r, name, red=ReduceOp.ADASUM))
+    resps = c.poll_responses({"a": 16, "b": 16})
+    assert sorted(r.tensor_names[0] for r in resps) == ["a", "b"]
+    assert all(len(r.tensor_names) == 1 for r in resps)
+
+
+def test_non_sum_allreduce_with_joined_rank_is_error(make_coord):
+    """A joined rank contributes zeros — an identity only for
+    sum/average, so completing a min allreduce via a join must error."""
+    c = make_coord(2, 1 << 20)
+    c.submit(_req(0, "hvd.join", op=RequestType.JOIN, dtype=DataType.UINT8))
+    c.submit(_req(1, "t", red=ReduceOp.MIN))
+    resps = c.poll_responses({"t": 16})
+    data = [r for r in resps if r.response_type != ResponseType.JOIN]
+    assert data[0].response_type == ResponseType.ERROR
+    assert "cannot complete after a rank has joined" in \
+        data[0].error_message
+    # sum/average still complete through the join.
+    c2 = make_coord(2, 1 << 20)
+    c2.submit(_req(0, "hvd.join", op=RequestType.JOIN,
+                   dtype=DataType.UINT8))
+    c2.submit(_req(1, "t2", red=ReduceOp.AVERAGE))
+    resps = c2.poll_responses({"t2": 16})
+    data = [r for r in resps if r.response_type != ResponseType.JOIN]
+    assert data[0].response_type == ResponseType.ALLREDUCE

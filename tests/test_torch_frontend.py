@@ -227,3 +227,23 @@ def test_rank_size_surface(thvd):
     assert thvd.rank() == 0
     assert thvd.local_rank() == 0
     assert thvd.mpi_threads_supported() is True
+
+
+def test_torch_allreduce_op_kwarg(hvd):
+    """The post-v0.13 op= kwarg on the torch surface: Min/Max/Adasum
+    reduce CPU torch tensors through the same wire as average/sum."""
+    import horovod_tpu.frontends.torch as thvd
+
+    t = torch.tensor([3.0, -1.0])
+    np.testing.assert_allclose(
+        thvd.allreduce(t, op=hvd.Min).numpy(), [3.0, -1.0])
+    np.testing.assert_allclose(
+        thvd.allreduce(t, op=hvd.Max).numpy(), [3.0, -1.0])
+    # Replicated contributions: adasum is idempotent, product is x**n.
+    np.testing.assert_allclose(
+        thvd.allreduce(t, op=hvd.Adasum).numpy(), [3.0, -1.0], rtol=1e-6)
+    np.testing.assert_allclose(
+        thvd.allreduce(torch.tensor([2.0]), op=hvd.Product).numpy(),
+        [2.0 ** hvd.size()])
+    with pytest.raises(ValueError, match="not both"):
+        thvd.allreduce(t, average=True, op=hvd.Sum)
