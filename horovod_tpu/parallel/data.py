@@ -32,6 +32,7 @@ Two execution contexts, chosen automatically:
 
 from __future__ import annotations
 
+import math
 import os
 from typing import Any, NamedTuple, Optional
 
@@ -41,6 +42,21 @@ import numpy as np
 
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
+from ..ops.wire import ReduceOp
+
+
+def _resolve_grad_op(average: bool, op) -> ReduceOp:
+    """Gradient-reduction operator: op supersedes average (the post-v0.13
+    contract); only sum/average/adasum are meaningful for gradients."""
+    if op is None:
+        return ReduceOp.AVERAGE if average else ReduceOp.SUM
+    red = ReduceOp(op)
+    if red not in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.ADASUM):
+        raise ValueError(
+            f"gradient reduction supports op=Average/Sum/Adasum; got "
+            f"{red.name.lower()} (min/max/product are not gradient "
+            f"combiners).")
+    return red
 
 
 def _in_replica_context() -> bool:
@@ -62,9 +78,52 @@ def _fusion_threshold_bytes() -> int:
     return int(os.environ.get("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024))
 
 
+def _adasum_gradients(grads):
+    """Whole-gradient Adasum inside the replica trace.
+
+    The model gradient is ONE logical vector here (unlike user-visible
+    eager allreduces, which are independent per-tensor ops and therefore
+    never fuse under adasum), so the scale-insensitive combination
+    (arXiv:2006.02924) runs on the flattened concatenation: log2(n)
+    ``ppermute`` exchange rounds on ICI, each combining partner vectors
+    with ``(1 - a·b/2||a||²) a + (1 - a·b/2||b||²) b`` — total wire cost
+    log2(n) × |grad|, vs 2×|grad|(n-1)/n for a ring allreduce.
+    """
+    from ..ops.sparse import IndexedSlices
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        grads, is_leaf=lambda g: isinstance(g, IndexedSlices))
+    if any(isinstance(g, IndexedSlices) for g in leaves):
+        raise ValueError(
+            "op=Adasum does not support sparse (IndexedSlices) gradients; "
+            "pass sparse_as_dense=True to densify them first.")
+    n = jax.lax.axis_size(REPLICA_AXIS)
+    if n & (n - 1) != 0:
+        raise ValueError(
+            f"op=Adasum requires a power-of-two replica count for its "
+            f"recursive-doubling ppermute ladder; got {n}.")
+    v = jnp.concatenate(
+        [jnp.ravel(g).astype(jnp.float32) for g in leaves])
+    for r in range(int(math.log2(n))):
+        dist = 1 << r
+        perm = [(i, i ^ dist) for i in range(n)]
+        other = jax.lax.ppermute(v, REPLICA_AXIS, perm)
+        dot = jnp.sum(v * other)
+        na = jnp.sum(v * v)
+        nb = jnp.sum(other * other)
+        ca = 1.0 - jnp.where(na > 0, dot / (2.0 * na), 0.0)
+        cb = 1.0 - jnp.where(nb > 0, dot / (2.0 * nb), 0.0)
+        v = ca * v + cb * other
+    out, off = [], 0
+    for g in leaves:
+        out.append(v[off:off + g.size].reshape(g.shape).astype(g.dtype))
+        off += g.size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def allreduce_gradients(grads, average: bool = True,
                         fusion_threshold: Optional[int] = None,
-                        compression=None):
+                        compression=None, op=None):
     """Cross-replica gradient reduction with Tensor Fusion bucketing.
 
     Must be called inside a replica-axis trace (shard_map/pmap).  Gradients
@@ -79,6 +138,11 @@ def allreduce_gradients(grads, average: bool = True,
     wire and restores the dtype after — sparse leaves already ship a
     minimal payload and pass through uncompressed.
 
+    ``op`` (hvd.Average/Sum/Adasum, superseding ``average``) selects the
+    combiner; Adasum runs the whole-gradient ppermute ladder (see
+    :func:`_adasum_gradients`) and ignores fusion_threshold/compression
+    (its dots are defined on the full-precision gradient).
+
     :class:`~horovod_tpu.ops.sparse.IndexedSlices` leaves exchange as an
     all_gather of (values, indices) — the reference's sparse branch
     (tensorflow/__init__.py:67-78) — and stay sparse in the result.
@@ -86,6 +150,10 @@ def allreduce_gradients(grads, average: bool = True,
     from ..ops.compression import NoneCompressor
     from ..ops.sparse import IndexedSlices
 
+    red = _resolve_grad_op(average, op)
+    if red == ReduceOp.ADASUM:
+        return _adasum_gradients(grads)
+    average = red == ReduceOp.AVERAGE
     compression = compression or NoneCompressor
     threshold = (_fusion_threshold_bytes()
                  if fusion_threshold is None else fusion_threshold)
@@ -223,6 +291,30 @@ def _eager_allreduce_grads(grads, average: bool = True, compression=None):
     return jax.tree_util.tree_unflatten(treedef, red)
 
 
+def _eager_adasum_grads(grads):
+    """Dynamic-path whole-gradient Adasum: one flattened vector through
+    the eager wire (same semantics as the static ladder — each process
+    contributes its gradient as one logical vector)."""
+    from ..ops import collective as C
+    from ..ops.sparse import IndexedSlices
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        grads, is_leaf=lambda g: isinstance(g, IndexedSlices))
+    if any(isinstance(g, IndexedSlices) for g in leaves):
+        raise ValueError(
+            "op=Adasum does not support sparse (IndexedSlices) gradients; "
+            "pass sparse_as_dense=True to densify them first.")
+    flat = jnp.concatenate([jnp.ravel(jnp.asarray(g, jnp.float32))
+                            for g in leaves])
+    red = C.allreduce(flat, op=ReduceOp.ADASUM, name="grad.adasum")
+    out, off = [], 0
+    for g in leaves:
+        out.append(red[off:off + np.size(g)].reshape(np.shape(g)).astype(
+            jnp.asarray(g).dtype))
+        off += np.size(g)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 class DistributedOptimizer:
     """Wrap an optax optimizer so gradients are averaged across replicas
     before the update (≙ hvd.DistributedOptimizer in every reference
@@ -240,9 +332,13 @@ class DistributedOptimizer:
     def __init__(self, optimizer, average: bool = True,
                  fusion_threshold: Optional[int] = None,
                  name: Optional[str] = None, sparse_as_dense: bool = False,
-                 compression=None):
+                 compression=None, op=None):
         self._inner = optimizer
         self._average = average
+        # op=hvd.Adasum selects scale-insensitive whole-gradient combining
+        # (the post-v0.13 DistributedOptimizer op= kwarg); validated here
+        # so a bad op fails at construction, not mid-training.
+        self._op = None if op is None else _resolve_grad_op(average, op)
         self._fusion_threshold = fusion_threshold
         self._name = name or "DistributedOptimizer"
         # ≙ the reference's device_dense/device_sparse per-op routing
@@ -272,10 +368,14 @@ class DistributedOptimizer:
             grads = allreduce_gradients(
                 grads, average=self._average,
                 fusion_threshold=self._fusion_threshold,
-                compression=self._compression)
+                compression=self._compression, op=self._op)
         elif _state.is_initialized() and _state.size() > 1:
-            grads = _eager_allreduce_grads(grads, average=self._average,
-                                           compression=self._compression)
+            if self._op == ReduceOp.ADASUM:
+                grads = _eager_adasum_grads(grads)
+            else:
+                grads = _eager_allreduce_grads(grads,
+                                               average=self._average,
+                                               compression=self._compression)
         elif _state.is_initialized():
             pass  # size 1: reduction is the identity (reference behaves the
             #       same — collectives still run but are trivial).

@@ -131,7 +131,7 @@ class _ThrottledStep:
 
 
 def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
-               has_aux, donate, has_state):
+               has_aux, donate, has_state, op=None):
     """Shared builder behind :func:`make_train_step` and
     :func:`make_train_step_with_state` — one place wires the reduction,
     pmean placement, shard_map specs and donation for both variants."""
@@ -140,6 +140,8 @@ def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
     compression = None
     if isinstance(optimizer, DistributedOptimizer):
         average = optimizer._average
+        if op is None:
+            op = optimizer._op
         if optimizer._fusion_threshold is not None:
             fusion_threshold = optimizer._fusion_threshold
         compression = optimizer._compression
@@ -153,10 +155,11 @@ def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
         out, grads = grad_fn(*args)
         loss = out[0] if (has_aux or has_state) else out
         aux = out[1] if (has_aux or has_state) else None
-        # Fused cross-replica gradient reduction (Tensor Fusion over psum).
+        # Fused cross-replica gradient reduction (Tensor Fusion over psum;
+        # op=Adasum swaps in the whole-gradient ppermute ladder).
         grads = allreduce_gradients(grads, average=average,
                                     fusion_threshold=fusion_threshold,
-                                    compression=compression)
+                                    compression=compression, op=op)
         # Report the global mean loss, like MetricAverageCallback would
         # (keras/callbacks.py:37-87).  Aux outputs — metrics, or the
         # updated BatchNorm statistics in the stateful variant — are
@@ -207,6 +210,7 @@ def make_train_step(
     fusion_threshold: Optional[int] = None,
     has_aux: bool = False,
     donate: bool = True,
+    op=None,
 ):
     """Build the jitted data-parallel train step.
 
@@ -220,6 +224,8 @@ def make_train_step(
       average: average (True) or sum (False) gradients across replicas.
       fusion_threshold: Tensor-Fusion bucket size in bytes; defaults to
         ``HOROVOD_FUSION_THRESHOLD`` (64 MB).
+      op: hvd.Average/Sum/Adasum (supersedes ``average``); Adasum compiles
+        the whole-gradient ppermute ladder into the step.
 
     Returns:
       ``step(params, opt_state, batch) -> (params, opt_state, loss[, aux])``
@@ -227,7 +233,7 @@ def make_train_step(
       the replica count.
     """
     return _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
-                      has_aux, donate, has_state=False)
+                      has_aux, donate, has_state=False, op=op)
 
 
 def make_train_step_with_state(
@@ -237,6 +243,7 @@ def make_train_step_with_state(
     average: bool = True,
     fusion_threshold: Optional[int] = None,
     donate: bool = True,
+    op=None,
 ):
     """Train-step builder for models carrying non-trained state (BatchNorm
     statistics): ``loss_fn(params, model_state, batch) -> (loss, new_state)``;
@@ -247,7 +254,7 @@ def make_train_step_with_state(
     (params, model_state, opt_state, loss)``.
     """
     return _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
-                      has_aux=False, donate=donate, has_state=True)
+                      has_aux=False, donate=donate, has_state=True, op=op)
 
 
 def make_parallel_train_step(loss_fn: Callable[..., Any], optimizer,
