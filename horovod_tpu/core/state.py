@@ -89,6 +89,12 @@ class _GlobalState:
     # Tensor-fusion threshold in bytes (reference default 64 MB,
     # operations.cc:140, env HOROVOD_FUSION_THRESHOLD).
     fusion_threshold_bytes: int = 64 * 1024 * 1024
+    # Background tick period (reference 5 ms, operations.cc:1221; env
+    # HOROVOD_CYCLE_TIME in milliseconds, the post-v0.13 name).
+    tick_seconds: float = 0.005
+    # Autotuner (utils.autotune.Autotuner) when HOROVOD_AUTOTUNE=1;
+    # coordinator-side only — fusion decisions are made there.
+    autotuner: Any = None
     # Timeline (utils.timeline.Timeline) when HOROVOD_TIMELINE is set.
     timeline: Any = None
     # Native coordinator handle (ops.coordinator.Coordinator).
@@ -168,6 +174,8 @@ def init(devices=None) -> None:
         _state.fusion_threshold_bytes = int(
             os.environ.get("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024)
         )
+        _state.tick_seconds = float(
+            os.environ.get("HOROVOD_CYCLE_TIME", 5.0)) / 1000.0
         _state.shutdown = False
         _state.peer_shutdown = False
         _state.initialized = True
@@ -221,6 +229,23 @@ def init(devices=None) -> None:
                 fusion_threshold=_state.fusion_threshold_bytes,
                 timeline=_state.timeline,
             )
+
+        # Autotune (HOROVOD_AUTOTUNE=1, post-v0.13 subsystem): explore
+        # (fusion_threshold, cycle_time) on the process that makes the
+        # fusion decisions — the coordinator.
+        if os.environ.get("HOROVOD_AUTOTUNE") == "1" \
+                and _state.coordinator is not None:
+            from ..utils.autotune import Autotuner
+
+            def _apply_tuning(threshold: int, cycle: float) -> None:
+                _state.fusion_threshold_bytes = threshold
+                _state.tick_seconds = cycle
+                if _state.coordinator is not None:
+                    _state.coordinator.set_fusion_threshold(threshold)
+
+            _state.autotuner = Autotuner(_apply_tuning)
+        else:
+            _state.autotuner = None
 
         # Spawn the background tick thread serving async eager collectives
         # (≙ InitializeHorovodOnce spawning BackgroundThreadLoop,
@@ -283,6 +308,9 @@ def shutdown() -> None:
     with _state.lock:
         _state.bg_thread = None
         _state.bg_stop = None
+        if _state.autotuner is not None:
+            _state.autotuner.close()
+            _state.autotuner = None
         if _state.timeline is not None:
             _state.timeline.close()
             _state.timeline = None

@@ -382,6 +382,14 @@ class Coordinator {
     return static_cast<ssize_t>(out_buffer_.size());
   }
 
+  // Autotune hook: the fusion threshold is runtime-adjustable (≙ the
+  // post-v0.13 HOROVOD_AUTOTUNE subsystem re-tuning
+  // TensorFusionThresholdBytes between cycles).
+  void SetFusionThreshold(int64_t v) {
+    std::lock_guard<std::mutex> g(mu_);
+    fusion_threshold_ = v;
+  }
+
   // ≙ CheckForStalledTensors (operations.cc:1072-1115).
   std::string CheckStalled(double threshold_seconds) {
     std::lock_guard<std::mutex> g(mu_);
@@ -493,6 +501,10 @@ int hvd_coord_fetch_responses(void* c, char* out, int cap) {
 void hvd_coord_withdraw(void* c, const char* name, int len, int rank) {
   static_cast<hvdtpu::Coordinator*>(c)->Withdraw(std::string(name, len),
                                                  rank);
+}
+
+void hvd_coord_set_fusion_threshold(void* c, long long v) {
+  static_cast<hvdtpu::Coordinator*>(c)->SetFusionThreshold(v);
 }
 
 int hvd_coord_check_stalled(void* c, double threshold, char* out, int cap) {

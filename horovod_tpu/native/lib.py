@@ -113,6 +113,9 @@ def _load() -> None:
     if hasattr(_lib, "hvd_coord_withdraw"):  # absent in a stale prebuilt
         _lib.hvd_coord_withdraw.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    if hasattr(_lib, "hvd_coord_set_fusion_threshold"):
+        _lib.hvd_coord_set_fusion_threshold.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong]
 
     _lib.hvd_timeline_create.argtypes = [ctypes.c_char_p]
     _lib.hvd_timeline_create.restype = ctypes.c_void_p

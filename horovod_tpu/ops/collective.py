@@ -518,10 +518,12 @@ TICK_SECONDS = 0.005
 def _background_loop(stop_event: threading.Event) -> None:
     """≙ BackgroundThreadLoop (operations.cc:1167-1475): drain the async op
     queue on a fixed tick so ``*_async`` collectives make progress even if
-    the caller never polls."""
+    the caller never polls.  The period is runtime-adjustable
+    (HOROVOD_CYCLE_TIME / the autotuner)."""
     import traceback
 
-    while not stop_event.wait(TICK_SECONDS):
+    st = _state.global_state()
+    while not stop_event.wait(st.tick_seconds or TICK_SECONDS):
         try:
             _drain()
         except Exception:
@@ -961,7 +963,13 @@ def _drain() -> None:
                 if resps:
                     tp.broadcast_responses(resps)
                 for resp in resps:
-                    _execute_response(resp, _queue.take(resp.tensor_names))
+                    ops = _queue.take(resp.tensor_names)
+                    _execute_response(resp, ops)
+                    if st.autotuner is not None:
+                        st.autotuner.record_bytes(
+                            sum(o.nbytes for o in ops))
+                if st.autotuner is not None:
+                    st.autotuner.maybe_step()
             else:
                 while True:
                     resps = tp.poll_responses()
@@ -975,6 +983,10 @@ def _drain() -> None:
         for resp in st.coordinator.poll_responses(meta):
             ops = _queue.take(resp.tensor_names)
             _execute_response(resp, ops)
+            if st.autotuner is not None:
+                st.autotuner.record_bytes(sum(o.nbytes for o in ops))
+        if st.autotuner is not None:
+            st.autotuner.maybe_step()
 
 
 # ---------------------------------------------------------------------------

@@ -368,6 +368,12 @@ class PyCoordinator:
                     f"remaining replicas to do the same.")
         return warnings
 
+    def set_fusion_threshold(self, v: int) -> None:
+        """Autotune hook (≙ the post-v0.13 HOROVOD_AUTOTUNE subsystem
+        re-tuning TensorFusionThresholdBytes between cycles)."""
+        with self._lock:
+            self.fusion_threshold = v
+
     def request_shutdown(self) -> None:
         self.shutdown = True
 
@@ -435,6 +441,10 @@ class NativeCoordinator:
         text = out.raw[:n].decode("utf-8")
         return [w for w in text.split("\n") if w]
 
+    def set_fusion_threshold(self, v: int) -> None:
+        self.fusion_threshold = v
+        self._lib.hvd_coord_set_fusion_threshold(self._ptr, v)
+
     def close(self) -> None:
         if self._ptr:
             self._lib.hvd_coord_destroy(self._ptr)
@@ -450,7 +460,8 @@ class Coordinator:
         self._last_stall_check = time.monotonic()
         # Gate on the newest symbol so a stale prebuilt .so falls back to
         # the Python twin instead of AttributeError-ing at call time.
-        if _native.NATIVE and hasattr(_native.raw(), "hvd_coord_withdraw"):
+        if _native.NATIVE and hasattr(_native.raw(),
+                                      "hvd_coord_set_fusion_threshold"):
             self._impl = NativeCoordinator(size, fusion_threshold)
         else:
             self._impl = PyCoordinator(size, fusion_threshold)
@@ -468,6 +479,9 @@ class Coordinator:
 
     def withdraw(self, name: str, rank: int) -> None:
         self._impl.withdraw(name, rank)
+
+    def set_fusion_threshold(self, v: int) -> None:
+        self._impl.set_fusion_threshold(v)
 
     def poll_responses(self, sizes_bytes: Dict[str, int]) -> List[Response]:
         now = time.monotonic()

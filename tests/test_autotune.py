@@ -1,0 +1,118 @@
+"""Autotuner: explore-then-commit over (fusion_threshold, cycle_time).
+
+≙ the post-v0.13 HOROVOD_AUTOTUNE subsystem (the v0.13 reference has
+only static env vars, operations.cc:140, :1207-1210); the TPU redesign
+(deterministic grid sweep instead of Bayesian opt) is argued in
+horovod_tpu/utils/autotune.py.  Tests inject a fake clock so windows
+close deterministically.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.utils.autotune import Autotuner
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _make(clock, thresholds, cycles, warmup=1, sample=1.0, log=None):
+    applied = []
+    tuner = Autotuner(lambda th, cy: applied.append((th, cy)),
+                      thresholds=thresholds, cycles=cycles,
+                      warmup_samples=warmup, sample_seconds=sample,
+                      log_path=log, clock=clock)
+    return tuner, applied
+
+
+def test_explores_all_configs_then_commits_to_best():
+    clock = _Clock()
+    thresholds, cycles = [1024, 4096], [0.002, 0.01]
+    tuner, applied = _make(clock, thresholds, cycles)
+    # Byte rate per config: make (4096, 0.002) the clear winner.
+    rates = {(1024, 0.002): 10, (1024, 0.01): 5,
+             (4096, 0.002): 100, (4096, 0.01): 20}
+
+    # Warmup window: bytes discarded.
+    clock.t = 1.1
+    tuner.record_bytes(999999)
+    tuner.maybe_step()
+    assert not tuner.done
+    while not tuner.done:
+        cfg = applied[-1]
+        tuner.record_bytes(rates[cfg])
+        clock.t += 1.0
+        tuner.maybe_step()
+    assert tuner.committed == (4096, 0.002)
+    assert applied[-1] == (4096, 0.002)
+    # Every config was tried exactly once before the commit.
+    assert sorted(applied[:-1]) == sorted(rates.keys())
+
+
+def test_log_records_samples_and_commit(tmp_path):
+    clock = _Clock()
+    log = str(tmp_path / "autotune.csv")
+    tuner, applied = _make(clock, [512], [0.005], warmup=0, log=log)
+    tuner.record_bytes(50)
+    clock.t = 1.0
+    tuner.maybe_step()
+    assert tuner.done
+    tuner.close()
+    lines = open(log).read().splitlines()
+    assert lines[0].startswith("score_bytes_per_sec")
+    assert lines[1] == "50.0,512,0.005"
+    assert lines[2].startswith("# committed,512")
+
+
+def test_dormant_after_commit():
+    clock = _Clock()
+    tuner, applied = _make(clock, [512], [0.005], warmup=0)
+    clock.t = 1.0
+    tuner.maybe_step()
+    assert tuner.done
+    n = len(applied)
+    clock.t = 50.0
+    tuner.maybe_step()  # no further exploration or re-application
+    assert len(applied) == n
+
+
+def test_autotune_env_contract(monkeypatch, tmp_path):
+    """HOROVOD_AUTOTUNE=1 activates the tuner at init; sample windows
+    driven by real eager traffic re-tune the live coordinator's fusion
+    threshold; HOROVOD_CYCLE_TIME seeds the tick."""
+    import jax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.core import state as _state
+
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "0")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_SAMPLE_SECONDS", "0.05")
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "2.0")
+    hvd.init(devices=jax.devices())
+    try:
+        st = _state.global_state()
+        assert st.autotuner is not None
+        assert st.tick_seconds == pytest.approx(0.002)
+        seen = set()
+        for i in range(400):
+            hvd.allreduce(jnp.ones((8,)), name=f"tune.{i}",
+                          average=False)
+            seen.add(st.coordinator._impl.fusion_threshold)
+            if st.autotuner.done:
+                break
+        assert st.autotuner.done, "sweep did not finish"
+        assert len(seen) > 1, "fusion threshold was never re-tuned"
+        committed = st.autotuner.committed
+        assert st.fusion_threshold_bytes == committed[0]
+        assert st.tick_seconds == committed[1]
+    finally:
+        hvd.shutdown()
