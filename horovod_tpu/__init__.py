@@ -45,6 +45,7 @@ from .ops.collective import (  # noqa: F401
     Min,
     Product,
     Sum,
+    add_process_set,
     allgather,
     allgather_async,
     allreduce,
@@ -58,6 +59,7 @@ from .ops.collective import (  # noqa: F401
     shard,
     synchronize,
 )
+from .ops.process_set import ProcessSet  # noqa: F401
 from .ops.wire import ReduceOp  # noqa: F401
 from .ops.compression import Compression  # noqa: F401
 from .ops.objects import allgather_object, broadcast_object  # noqa: F401

@@ -95,6 +95,10 @@ class _GlobalState:
     # Autotuner (utils.autotune.Autotuner) when HOROVOD_AUTOTUNE=1;
     # coordinator-side only — fusion decisions are made there.
     autotuner: Any = None
+    # Registered process sets (ops.process_set.ProcessSet) by id; id 0
+    # (the global set) is implicit and never stored here.
+    process_sets: dict = field(default_factory=dict)
+    next_process_set_id: int = 1
     # Timeline (utils.timeline.Timeline) when HOROVOD_TIMELINE is set.
     timeline: Any = None
     # Native coordinator handle (ops.coordinator.Coordinator).
@@ -178,6 +182,8 @@ def init(devices=None) -> None:
             os.environ.get("HOROVOD_CYCLE_TIME", 5.0)) / 1000.0
         _state.shutdown = False
         _state.peer_shutdown = False
+        _state.process_sets = {}
+        _state.next_process_set_id = 1
         _state.initialized = True
 
         # Timeline: rank-0-only Chrome tracing, same env contract as the
@@ -311,6 +317,9 @@ def shutdown() -> None:
         if _state.autotuner is not None:
             _state.autotuner.close()
             _state.autotuner = None
+        for ps in _state.process_sets.values():
+            ps.close()
+        _state.process_sets = {}
         if _state.timeline is not None:
             _state.timeline.close()
             _state.timeline = None

@@ -274,6 +274,7 @@ class Coordinator {
 
     Response resp;
     resp.tensor_names = {name};
+    resp.process_set_id = first.process_set_id;
     if (!error.empty()) {
       resp.response_type = ResponseType::kError;
       resp.error_message = error;
@@ -320,6 +321,27 @@ class Coordinator {
     withdrawn_.push_back(std::move(resp));
   }
 
+  // Bytes of one replica's tensor for a response: the queue-side size
+  // table when present, else shape × dtype from the response itself (a
+  // process set excluding the controller has no entries in ITS queue;
+  // an unbounded 0 fallback would defeat the threshold).  Must mirror
+  // ops/coordinator.py::nbytes_of.
+  int64_t NBytesOf(const Response& r,
+                   const std::unordered_map<std::string, int64_t>& sizes) {
+    auto it = sizes.find(r.tensor_names.empty() ? std::string()
+                                                : r.tensor_names[0]);
+    if (it != sizes.end()) return it->second;
+    int64_t n = 1;
+    if (!r.tensor_shapes.empty())
+      for (int64_t d : r.tensor_shapes[0]) n *= d;
+    DataType dt = DataType::kFloat32;
+    auto dit = dtype_by_name_.find(r.tensor_names.empty()
+                                       ? std::string()
+                                       : r.tensor_names[0]);
+    if (dit != dtype_by_name_.end()) dt = dit->second;
+    return n * DataTypeSize(dt);
+  }
+
   // ≙ the response fusion loop (operations.cc:1328-1374): same-device,
   // same-dtype ALLREDUCE responses merge under the byte threshold.
   // `sizes` maps tensor name → payload bytes of one replica's tensor.
@@ -339,17 +361,14 @@ class Coordinator {
         fused.push_back(std::move(r));
         continue;
       }
-      auto szit = sizes.find(r.tensor_names[0]);
-      int64_t total = szit == sizes.end() ? 0 : szit->second;
+      int64_t total = NBytesOf(r, sizes);
       DataType dt = dtype_by_name_[r.tensor_names[0]];
       for (size_t j = i + 1; j < responses.size();) {
         Response& nxt = responses[j];
-        auto nit = sizes.find(nxt.tensor_names.empty()
-                                  ? std::string()
-                                  : nxt.tensor_names[0]);
-        int64_t nbytes = nit == sizes.end() ? 0 : nit->second;
+        int64_t nbytes = NBytesOf(nxt, sizes);
         if (nxt.response_type == ResponseType::kAllreduce &&
             nxt.devices == r.devices && nxt.reduce_op == r.reduce_op &&
+            nxt.process_set_id == r.process_set_id &&
             !nxt.tensor_names.empty() &&
             dtype_by_name_[nxt.tensor_names[0]] == dt &&
             total + nbytes <= fusion_threshold_) {

@@ -21,6 +21,21 @@ bool ReadLE(const uint8_t* buf, size_t len, size_t* off, T* out) {
 
 }  // namespace
 
+int DataTypeSize(DataType t) {
+  switch (t) {
+    case DataType::kUint8: case DataType::kInt8: case DataType::kBool:
+      return 1;
+    case DataType::kUint16: case DataType::kInt16:
+    case DataType::kBfloat16: case DataType::kFloat16:
+      return 2;
+    case DataType::kInt32: case DataType::kFloat32: case DataType::kUint32:
+      return 4;
+    case DataType::kInt64: case DataType::kFloat64: case DataType::kUint64:
+      return 8;
+  }
+  return 4;
+}
+
 const char* ReduceOpName(ReduceOp op) {
   switch (op) {
     case ReduceOp::kAverage: return "average";
@@ -60,6 +75,7 @@ std::string Request::Pack() const {
   Append<int32_t>(&out, root_rank);
   Append<int32_t>(&out, device);
   Append<uint8_t>(&out, static_cast<uint8_t>(reduce_op));
+  Append<uint16_t>(&out, process_set_id);
   Append<uint16_t>(&out, static_cast<uint16_t>(tensor_name.size()));
   out.append(tensor_name);
   Append<uint8_t>(&out, static_cast<uint8_t>(tensor_shape.size()));
@@ -77,6 +93,7 @@ ssize_t Request::Unpack(const uint8_t* buf, size_t len, Request* out) {
   if (!ReadLE(buf, len, &off, &out->root_rank)) return -1;
   if (!ReadLE(buf, len, &off, &out->device)) return -1;
   if (!ReadLE(buf, len, &off, &rop)) return -1;
+  if (!ReadLE(buf, len, &off, &out->process_set_id)) return -1;
   if (!ReadLE(buf, len, &off, &nlen)) return -1;
   if (off + nlen > len) return -1;
   out->tensor_name.assign(reinterpret_cast<const char*>(buf + off), nlen);
@@ -116,6 +133,7 @@ std::string Response::Pack() const {
     for (int64_t d : shape) Append<int64_t>(&out, d);
   }
   Append<uint8_t>(&out, static_cast<uint8_t>(reduce_op));
+  Append<uint16_t>(&out, process_set_id);
   return out;
 }
 

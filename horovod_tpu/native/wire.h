@@ -21,6 +21,7 @@ enum class DataType : uint8_t {
 };
 
 const char* DataTypeName(DataType t);
+int DataTypeSize(DataType t);  // bytes per element (≙ wire.dtype_size)
 
 // ≙ MPIRequestType / MPIResponseType (mpi_message.h); JOIN is the
 // post-v0.13 uneven-workload barrier (see ops/wire.py).
@@ -48,6 +49,8 @@ struct Request {
   int32_t device;
   // ALLREDUCE only; coordinator-validated for cross-rank agreement.
   ReduceOp reduce_op = ReduceOp::kAverage;
+  // Process set (0 = global); ranks are set-local for non-global sets.
+  uint16_t process_set_id = 0;
   std::string tensor_name;
   std::vector<int64_t> tensor_shape;
 
@@ -71,6 +74,8 @@ struct Response {
   std::vector<std::vector<int64_t>> tensor_shapes;
   // ALLREDUCE: validated reduction operator (fusion is homogeneous in it).
   ReduceOp reduce_op = ReduceOp::kAverage;
+  // Process set the response belongs to (0 = global).
+  uint16_t process_set_id = 0;
 
   std::string Pack() const;
 };

@@ -141,7 +141,15 @@ _name_lock = threading.Lock()
 _name_counters: Dict[str, int] = {}
 
 
-def _auto_name(prefix: str) -> str:
+def _auto_name(prefix: str, ps=None) -> str:
+    """Generate a unique op name (≙ the reference's prefix.noname.<n>,
+    torch/mpi_ops.cc:35-40).  Process-set ops get their own namespace
+    AND counter: set members consume names non-members never see, so a
+    shared counter would desync the ranks' auto-names for later GLOBAL
+    ops (and a bare collision could misroute a set response into a
+    non-member's global op of the same name)."""
+    if ps is not None:
+        prefix = f"ps{ps.process_set_id}.{prefix}"
     with _name_lock:
         n = _name_counters.get(prefix, 0) + 1
         _name_counters[prefix] = n
@@ -220,9 +228,57 @@ def shard(per_replica_values, axis: int = 0) -> jax.Array:
     return jax.device_put(x, sharding)
 
 
-def _classify(x, op: RequestType) -> _Contribution:
+def _on_mesh(xa, mesh):
+    """Normalize an array COMMITTED to a different device set (e.g. a
+    process-set collective's output fed into a global one, or vice
+    versa) back to host so the target mesh's jitted kernel can place it
+    — users naturally chain collectives across communicators.
+    Uncommitted arrays are left alone (jit moves those freely)."""
+    if isinstance(xa, jax.Array) and getattr(xa, "committed", False):
+        try:
+            devs = xa.sharding.device_set
+        except Exception:  # noqa: BLE001 — conservative across jax versions
+            return xa
+        if devs != set(mesh.devices.flat):
+            return jnp.asarray(np.asarray(xa))
+    return xa
+
+
+def _classify(x, op: RequestType, ps=None) -> _Contribution:
     st = _state.global_state()
     size = st.size
+    if ps is not None and not st.multiprocess:
+        # Single-process process-set contribution: replicated values (one
+        # logical contribution per member) or a per-member list for the
+        # ragged allgather.  A globally-sharded per-replica array has no
+        # canonical sub-slicing onto the set, so it is rejected.
+        k = ps.size()
+        if isinstance(x, (list, tuple)) and op == RequestType.ALLGATHER:
+            if len(x) != k:
+                raise ValueError(
+                    f"allgather over process set {ps.process_set_id} with "
+                    f"a list input needs one contribution per member "
+                    f"({k}), got {len(x)}.")
+            arrs = [jnp.asarray(v) for v in x]
+            shapes = [tuple(a.shape) for a in arrs]
+            return _Contribution(
+                per_replica=True, shapes=shapes, dtype=arrs[0].dtype,
+                devices=[_wire_device(a) for a in arrs], value=arrs,
+                ragged=len(set(shapes)) > 1,
+                orig_sizes=[s[0] if s else 0 for s in shapes])
+        xa = x if isinstance(x, jax.Array) else jnp.asarray(x)
+        if is_per_replica(xa):
+            raise ValueError(
+                "process-set collectives take replicated values or "
+                "per-member lists; a per-replica array sharded over the "
+                "GLOBAL mesh has no canonical sub-slicing onto the set — "
+                "use the static path with a mesh over the subset instead.")
+        xa = _on_mesh(xa, ps.mesh_and_kernels()[0])
+        payload = tuple(xa.shape)
+        return _Contribution(
+            per_replica=False, shapes=[payload] * k, dtype=xa.dtype,
+            devices=[_wire_device(xa)] * k, value=xa,
+            orig_sizes=[payload[0] if payload else 0] * k)
     if st.multiprocess:
         # Reference layout: each process contributes exactly its own local
         # tensor (one MPI rank per process); the coordinator learns the
@@ -253,6 +309,7 @@ def _classify(x, op: RequestType) -> _Contribution:
             ragged=ragged, orig_sizes=sizes)
     dev = _wire_device(x)
     xa = x if isinstance(x, jax.Array) else jnp.asarray(x)
+    xa = _on_mesh(xa, st.mesh)  # a set-collective output fed back in
     if is_per_replica(xa):
         payload = tuple(xa.shape[1:])
         return _Contribution(
@@ -399,6 +456,15 @@ def _mesh_kernels():
     return _kernels(tuple(d.id for d in st.devices))
 
 
+@functools.lru_cache(maxsize=None)
+def _subset_kernels(devs: tuple):
+    """Mesh + kernels over an arbitrary device subset, cached by the
+    device tuple so process sets over identical subsets (or the same set
+    re-registered across re-inits) share one compilation."""
+    mesh = jax.sharding.Mesh(np.asarray(devs), (REPLICA_AXIS,))
+    return mesh, _build_kernels(mesh)
+
+
 # ---------------------------------------------------------------------------
 # Multi-process eager path (reference: one MPI rank per process)
 # ---------------------------------------------------------------------------
@@ -424,11 +490,17 @@ def _mp_kernels():
     return _mp_mesh_and_kernels(tuple(d.id for d in st.devices))
 
 
-def _mp_global(x: jax.Array):
+def _mp_global(x: jax.Array, ps=None):
     """Local contribution → global ``[P, ...]`` array sharded over the
-    process mesh (this process supplies shard ``process_index``)."""
+    process mesh (this process supplies shard ``process_index``; for a
+    process set, the SET mesh with this process at its set-local slot)."""
     st = _state.global_state()
-    mesh, _ = _mp_kernels()
+    if ps is None:
+        mesh, _ = _mp_kernels()
+        count = st.process_count
+    else:
+        mesh, _ = ps.mesh_and_kernels()
+        count = ps.size()
     if isinstance(x, jax.Array) and not x.is_fully_addressable:
         # A previous collective's (replicated) output — or eager math on
         # one — fed straight back in: take this process's full local
@@ -439,7 +511,7 @@ def _mp_global(x: jax.Array):
     mine = [d for d in mesh.devices.flat
             if d.process_index == st.process_index][0]
     local = jax.device_put(jnp.asarray(x), mine)[None]
-    gshape = (st.process_count,) + tuple(local.shape[1:])
+    gshape = (count,) + tuple(local.shape[1:])
     spec = [None] * (local.ndim)
     spec[0] = REPLICA_AXIS
     sharding = NamedSharding(mesh, P(*spec))
@@ -468,6 +540,7 @@ class _QueuedOp:
     root_rank: int
     handle: int
     nbytes: int
+    ps: Any = None  # ProcessSet for non-global ops
 
 
 class _OpQueue:
@@ -505,6 +578,13 @@ class _OpQueue:
         with self._lock:
             return {n: o.nbytes for n, o in self._ops.items()}
 
+    def peek_ps(self, name: str):
+        """The ProcessSet of a pending op (None = global / unknown) —
+        lets synchronize route a withdrawal to the right coordinator."""
+        with self._lock:
+            op = self._ops.get(name)
+            return None if op is None else op.ps
+
 
 _queue = _OpQueue()
 _drain_lock = threading.Lock()
@@ -535,28 +615,32 @@ def _background_loop(stop_event: threading.Event) -> None:
 
 def _submit_requests(name: str, op: RequestType, c: _Contribution,
                      root_rank: int = -1,
-                     red_op: ReduceOp = ReduceOp.SUM) -> None:
+                     red_op: ReduceOp = ReduceOp.SUM, ps=None) -> None:
     st = _state.global_state()
+    psid = 0 if ps is None else ps.process_set_id
     if st.timeline is not None:
         st.timeline.negotiate_start(name, op.name)
     if st.multiprocess:
         # One request per process, carrying only THIS process's metadata —
         # cross-rank validation happens on real information at the rank-0
         # coordinator (≙ the MPI_Gatherv of MPIRequests,
-        # operations.cc:1240-1288).
+        # operations.cc:1240-1288).  Set requests carry SET-LOCAL ranks.
+        rank = st.process_index if ps is None else ps.rank()
         st.transport.submit(Request(
-            request_rank=st.process_index, request_type=op,
+            request_rank=rank, request_type=op,
             tensor_type=wire.dtype_of(c.dtype), tensor_name=name,
             root_rank=root_rank, device=c.devices[0],
-            tensor_shape=c.shapes[0], reduce_op=red_op))
+            tensor_shape=c.shapes[0], reduce_op=red_op,
+            process_set_id=psid))
         return
-    coord = st.coordinator
-    for r in range(st.size):
+    coord = st.coordinator if ps is None else ps.coordinator
+    for r in range(st.size if ps is None else ps.size()):
         coord.submit(Request(
             request_rank=r, request_type=op,
             tensor_type=wire.dtype_of(c.dtype), tensor_name=name,
             root_rank=root_rank, device=c.devices[r],
-            tensor_shape=c.shapes[r], reduce_op=red_op))
+            tensor_shape=c.shapes[r], reduce_op=red_op,
+            process_set_id=psid))
 
 
 def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
@@ -604,8 +688,14 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
         _execute_response_mp(resp, ops)
         return
 
+    # Process-set responses execute over the set's sub-mesh with the
+    # set's member count as the averaging denominator.
+    ps = st.process_sets.get(resp.process_set_id) \
+        if resp.process_set_id else None
+    denom = st.size if ps is None else ps.size()
+
     if resp.response_type == ResponseType.ALLREDUCE:
-        ks = _mesh_kernels()
+        ks = _mesh_kernels() if ps is None else ps.mesh_and_kernels()[1]
         # Sub-group by layout: per-replica vs replicated inputs reduce with
         # different shardings and cannot share one flat buffer.  The group
         # is homogeneous in red_op (the coordinator fuses like-op only).
@@ -621,7 +711,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
                 if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
                 out = kernel(o.contrib.value)
                 if o.red_op == ReduceOp.AVERAGE:
-                    out = _divide(out, st.size)
+                    out = _divide(out, denom)
                 if tl: tl.activity_end(o.name)
                 if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
                 hm._get(o.handle).result = out
@@ -654,14 +744,14 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
                     piece = red[offs:offs + n].reshape(o.contrib.shapes[0])
                 offs += n
                 if o.red_op == ReduceOp.AVERAGE:
-                    piece = _divide(piece, st.size)
+                    piece = _divide(piece, denom)
                 if tl: tl.activity_end(o.name)
                 if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
                 hm._get(o.handle).result = piece
         return
 
     if resp.response_type == ResponseType.ALLGATHER:
-        ks = _mesh_kernels()
+        ks = _mesh_kernels() if ps is None else ps.mesh_and_kernels()[1]
         for o in ops:
             c = o.contrib
             if tl: tl.start(o.name, "ALLGATHER")
@@ -675,7 +765,14 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
                         v, jnp.zeros((dmax - v.shape[0],) + rest, v.dtype)
                     ], axis=0) if v.shape[0] < dmax else v
                     for v in c.value])
-                padded = shard(padded)
+                if ps is None:
+                    padded = shard(padded)
+                else:
+                    mesh_ps, _ = ps.mesh_and_kernels()
+                    spec = [None] * padded.ndim
+                    spec[0] = REPLICA_AXIS
+                    padded = jax.device_put(
+                        padded, NamedSharding(mesh_ps, P(*spec)))
                 gathered = ks["gather_pr"](padded)  # [size*dmax, rest...]
                 def _unpad(g, sizes=tuple(sizes), dmax=dmax):
                     pieces = [g[i * dmax:i * dmax + s]
@@ -692,7 +789,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
         return
 
     if resp.response_type == ResponseType.BROADCAST:
-        ks = _mesh_kernels()
+        ks = _mesh_kernels() if ps is None else ps.mesh_and_kernels()[1]
         for o in ops:
             c = o.contrib
             if tl: tl.start(o.name, "BROADCAST")
@@ -703,7 +800,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
                 # Replicated input: broadcast is the identity, but still run
                 # a collective for execution parity with the reference's
                 # unconditional MPI_Bcast (operations.cc:1053-1055).
-                out = ks["psum_rep"](c.value) / _state.global_state().size \
+                out = ks["psum_rep"](c.value) / denom \
                     if jnp.issubdtype(c.value.dtype, jnp.inexact) \
                     else c.value
             if tl: tl.activity_end(o.name)
@@ -723,9 +820,21 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
     st = _state.global_state()
     tl = st.timeline
     hm = st.handle_manager
-    _, ks = _mp_kernels()
+    ps = st.process_sets.get(resp.process_set_id) \
+        if resp.process_set_id else None
+    if ps is not None:
+        if not ops:
+            # Not a member of this set (or a member with nothing pending,
+            # e.g. after shutdown poisoning): this process takes no part
+            # in the sub-mesh collective.
+            return
+        _, ks = ps.mesh_and_kernels()
+        denom = ps.size()
+    else:
+        _, ks = _mp_kernels()
+        denom = st.process_count
 
-    if st.joining and resp.tensor_type is not None \
+    if st.joining and ps is None and resp.tensor_type is not None \
             and len(ops) < len(resp.tensor_names):
         # This process called hvd.join(): participate in the peers'
         # collective with ZERO contributions so the SPMD program still
@@ -753,9 +862,9 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
             if tl: tl.start(o.name, "ALLREDUCE")
             if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
             out = ks[_OP_KERNEL[o.red_op] + "_out_rep"](
-                _mp_global(o.contrib.value))
+                _mp_global(o.contrib.value, ps))
             if o.red_op == ReduceOp.AVERAGE:
-                out = _divide(out, st.process_count)
+                out = _divide(out, denom)
             if tl: tl.activity_end(o.name)
             if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
             hm._get(o.handle).result = out
@@ -770,7 +879,8 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
         for o in ops:
             if tl: tl.activity_end(o.name)
             if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
-        red = ks[_OP_KERNEL[ops[0].red_op] + "_out_rep"](_mp_global(buf))
+        red = ks[_OP_KERNEL[ops[0].red_op] + "_out_rep"](
+            _mp_global(buf, ps))
         offs = 0
         for o in ops:
             n = int(np.prod(o.contrib.shapes[0], dtype=np.int64)) if \
@@ -780,7 +890,7 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
             piece = red[offs:offs + n].reshape(o.contrib.shapes[0])
             offs += n
             if o.red_op == ReduceOp.AVERAGE:
-                piece = _divide(piece, st.process_count)
+                piece = _divide(piece, denom)
             if tl: tl.activity_end(o.name)
             if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
             hm._get(o.handle).result = piece
@@ -793,15 +903,14 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
             if tl: tl.activity_start(o.name, "XLA_ALLGATHER")
             # The coordinator's response carries every rank's dim-0 extent
             # (≙ MPIResponse.tensor_sizes, mpi_message.h:48-51).
-            sizes = resp.tensor_sizes or \
-                [c.orig_sizes[0]] * st.process_count
+            sizes = resp.tensor_sizes or [c.orig_sizes[0]] * denom
             dmax = max(sizes)
             v = c.value
             if v.shape[0] < dmax:
                 pad = jnp.zeros((dmax - v.shape[0],) + tuple(v.shape[1:]),
                                 v.dtype)
                 v = jnp.concatenate([v, pad], axis=0)
-            gathered = ks["gather_pr"](_mp_global(v))  # [P*dmax, rest...]
+            gathered = ks["gather_pr"](_mp_global(v, ps))  # [P*dmax, ...]
             if any(s != dmax for s in sizes):
                 pieces = [gathered[i * dmax:i * dmax + s]
                           for i, s in enumerate(sizes)]
@@ -818,7 +927,8 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
             c = o.contrib
             if tl: tl.start(o.name, "BROADCAST")
             if tl: tl.activity_start(o.name, "XLA_BCAST")
-            out = ks["bcast_pr"](_mp_global(c.value), jnp.int32(o.root_rank))
+            out = ks["bcast_pr"](_mp_global(c.value, ps),
+                                 jnp.int32(o.root_rank))
             if tl: tl.activity_end(o.name)
             if tl: tl.end(o.name, dtype=str(c.dtype))
             hm._get(o.handle).result = out
@@ -959,7 +1069,12 @@ def _drain() -> None:
                 # Coordinator: poll, broadcast the fused responses to every
                 # worker, then execute locally in the same order
                 # (≙ MPI_Bcast of the response list, operations.cc:1290).
-                resps = st.coordinator.poll_responses(_queue.pending_meta())
+                tp.flush_unrouted()  # set requests that beat registration
+                meta = _queue.pending_meta()
+                resps = st.coordinator.poll_responses(meta)
+                for set_ps in list(st.process_sets.values()):
+                    if set_ps.coordinator is not None:
+                        resps += set_ps.coordinator.poll_responses(meta)
                 if resps:
                     tp.broadcast_responses(resps)
                 for resp in resps:
@@ -980,7 +1095,11 @@ def _drain() -> None:
                                           _queue.take(resp.tensor_names))
             return
         meta = _queue.pending_meta()
-        for resp in st.coordinator.poll_responses(meta):
+        resps = st.coordinator.poll_responses(meta)
+        for set_ps in list(st.process_sets.values()):
+            if set_ps.coordinator is not None:
+                resps += set_ps.coordinator.poll_responses(meta)
+        for resp in resps:
             ops = _queue.take(resp.tensor_names)
             _execute_response(resp, ops)
             if st.autotuner is not None:
@@ -1011,10 +1130,11 @@ def _resolve_op(average, op) -> ReduceOp:
     return ReduceOp.SUM
 
 
-def _check_reduce_op(red_op: ReduceOp, dtype) -> None:
+def _check_reduce_op(red_op: ReduceOp, dtype, process_set=None) -> None:
     st = _state.global_state()
     if red_op == ReduceOp.ADASUM:
-        n = _state.contributor_count()
+        n = (_state.contributor_count() if process_set is None
+             else process_set.size())
         if n & (n - 1) != 0:
             raise ValueError(
                 f"op=Adasum requires a power-of-two contributor count for "
@@ -1031,15 +1151,22 @@ def _check_reduce_op(red_op: ReduceOp, dtype) -> None:
 
 def _enqueue(x, op: RequestType, name: Optional[str],
              red_op: ReduceOp = ReduceOp.SUM,
-             root_rank: int = -1, prefix: str = "") -> int:
+             root_rank: int = -1, prefix: str = "",
+             process_set=None) -> int:
     _state._check_initialized()
     st = _state.global_state()
     if st.peer_shutdown:
         raise HorovodError(SHUT_DOWN_ERROR_MESSAGE)
-    c = _classify(x, op)
+    if process_set is not None and not process_set.included():
+        raise HorovodError(
+            f"rank {st.process_index} is not a member of process set "
+            f"{process_set.process_set_id} (ranks "
+            f"{list(process_set.ranks)}) and cannot submit collectives "
+            f"into it (the post-v0.13 process-set contract).")
+    c = _classify(x, op, ps=process_set)
     if op == RequestType.ALLREDUCE:
-        _check_reduce_op(red_op, c.dtype)
-    name = name or _auto_name(prefix or op.name.lower())
+        _check_reduce_op(red_op, c.dtype, process_set)
+    name = name or _auto_name(prefix or op.name.lower(), process_set)
     # Payload bytes of ONE replica's tensor — the quantity the reference's
     # fusion accounting uses (tensor->size(), operations.cc:1341-1352).
     item = wire.dtype_size(wire.dtype_of(c.dtype))
@@ -1047,21 +1174,25 @@ def _enqueue(x, op: RequestType, name: Optional[str],
     nbytes = int(np.prod(s0, dtype=np.int64)) * item if s0 else item
     handle = st.handle_manager.allocate(None, name=name)
     _queue.put(_QueuedOp(name=name, op=op, contrib=c, red_op=red_op,
-                         root_rank=root_rank, handle=handle, nbytes=nbytes))
-    _submit_requests(name, op, c, root_rank, red_op=red_op)
+                         root_rank=root_rank, handle=handle, nbytes=nbytes,
+                         ps=process_set))
+    _submit_requests(name, op, c, root_rank, red_op=red_op, ps=process_set)
     return handle
 
 
 def allreduce_async(tensor, average=None, name: Optional[str] = None,
-                    op=None) -> int:
+                    op=None, process_set=None) -> int:
     """Queue an allreduce; returns a handle for poll/synchronize
     (≙ horovod_torch_allreduce_async_*, torch/mpi_ops.cc:206-253).
     Averages by default for parity with the reference API
     (torch/mpi_ops.py:58, tensorflow/__init__.py:49); ``op`` takes any
     of hvd.Average/Sum/Adasum/Min/Max/Product (the post-v0.13 API) and
-    supersedes ``average``."""
+    supersedes ``average``; ``process_set`` (from
+    :func:`add_process_set`) restricts the collective to a rank
+    subset."""
     return _enqueue(tensor, RequestType.ALLREDUCE, name,
-                    red_op=_resolve_op(average, op), prefix="allreduce")
+                    red_op=_resolve_op(average, op), prefix="allreduce",
+                    process_set=process_set)
 
 
 def grouped_allreduce_async(tensors, average=None,
@@ -1091,19 +1222,78 @@ def grouped_allreduce(tensors, average=None, name: Optional[str] = None,
             for h in grouped_allreduce_async(tensors, average, name, op)]
 
 
-def allgather_async(tensor, name: Optional[str] = None) -> int:
-    return _enqueue(tensor, RequestType.ALLGATHER, name, prefix="allgather")
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set=None) -> int:
+    return _enqueue(tensor, RequestType.ALLGATHER, name, prefix="allgather",
+                    process_set=process_set)
 
 
 def broadcast_async(tensor, root_rank: int,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None, process_set=None) -> int:
     # In multi-process mode ranks are processes (the bcast mask compares
-    # against the process-mesh axis index), not devices.
-    bound = _state.contributor_count()
-    if not (0 <= root_rank < bound):
-        raise ValueError(f"root_rank {root_rank} outside [0, {bound}).")
+    # against the process-mesh axis index), not devices.  For a process
+    # set the API takes the GLOBAL rank (Horovod's convention) and
+    # translates it to the set-local index used on the wire.
+    if process_set is not None:
+        root_rank = process_set.local_rank_of(root_rank)
+    else:
+        bound = _state.contributor_count()
+        if not (0 <= root_rank < bound):
+            raise ValueError(f"root_rank {root_rank} outside [0, {bound}).")
     return _enqueue(tensor, RequestType.BROADCAST, name, root_rank=root_rank,
-                    prefix="broadcast")
+                    prefix="broadcast", process_set=process_set)
+
+
+def add_process_set(ranks):
+    """Register a process set (≙ the post-v0.13 ``hvd.add_process_set``).
+
+    ``ranks`` are GLOBAL rank numbers — replica indices in
+    single-process mode, process ranks in multi-process mode.  In
+    multi-process mode this is a COLLECTIVE call: every process must
+    call it with the identical ranks, in the same registration order
+    (Horovod's contract); registration is validated with an
+    allgather_object round over the global set and diverging
+    registrations raise on every rank.  Returns the
+    :class:`~horovod_tpu.ops.process_set.ProcessSet` to pass as
+    ``process_set=`` on collectives.
+    """
+    from .process_set import ProcessSet
+
+    _state._check_initialized()
+    st = _state.global_state()
+    ranks = tuple(sorted({int(r) for r in ranks}))
+    if not ranks:
+        raise ValueError("a process set needs at least one rank")
+    bound = st.process_count if st.multiprocess else st.size
+    bad = [r for r in ranks if not 0 <= r < bound]
+    if bad:
+        raise ValueError(
+            f"process-set ranks {bad} outside [0, {bound}).")
+    psid = st.next_process_set_id
+    if st.multiprocess:
+        from .objects import allgather_object
+
+        regs = allgather_object((psid, ranks),
+                                name=f"process_set.register.{psid}")
+        if any(reg != (psid, ranks) for reg in regs):
+            raise HorovodError(
+                f"add_process_set must be called by every process with "
+                f"identical ranks in the same order; this process "
+                f"registered set {psid} as {list(ranks)} but the job "
+                f"registered {regs}.")
+    st.next_process_set_id = psid + 1
+    ps = ProcessSet(psid, ranks)
+    # Per-set coordinator wherever negotiation happens: the rank-0
+    # controller in multi-process mode, the in-process coordinator
+    # single-process.
+    if st.coordinator is not None:
+        from .coordinator import Coordinator
+
+        ps.coordinator = Coordinator(
+            size=ps.size(), fusion_threshold=st.fusion_threshold_bytes,
+            timeline=st.timeline)
+    st.process_sets[psid] = ps
+    return ps
 
 
 def poll(handle: int) -> bool:
@@ -1146,10 +1336,15 @@ def synchronize(handle: int):
                 # SPMD hazard) this rank later skipping a broadcast
                 # response its peers execute and block on.
                 try:
+                    w_ps = _queue.peek_ps(h.name)
                     if st.process_index == 0:
-                        st.coordinator.withdraw(h.name, 0)
+                        coord = (st.coordinator if w_ps is None
+                                 else w_ps.coordinator)
+                        coord.withdraw(h.name, 0)
                     else:
-                        st.transport.withdraw(h.name)
+                        st.transport.withdraw(
+                            h.name,
+                            0 if w_ps is None else w_ps.process_set_id)
                 except (OSError, AttributeError):
                     pass  # controller unreachable: fall back to local
                 grace_dl = _time.monotonic() + float(_os.environ.get(
@@ -1182,12 +1377,13 @@ def synchronize(handle: int):
     return st.handle_manager.synchronize(handle)
 
 
-def allreduce(tensor, average=None, name: Optional[str] = None, op=None):
+def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
+              process_set=None):
     """Synchronous allreduce — mean by default, sum with ``average=False``
     (defaults match the reference: tensorflow/__init__.py:49,
     torch/mpi_ops.py:58), or any reduction via ``op`` —
     hvd.Average/Sum/Adasum/Min/Max/Product (the post-v0.13 API; ``op``
-    supersedes ``average``).
+    supersedes ``average``); ``process_set`` restricts to a rank subset.
 
     :class:`~horovod_tpu.ops.sparse.IndexedSlices` inputs dispatch to the
     sparse gather-of-(values, indices) path transparently, exactly like
@@ -1206,16 +1402,19 @@ def allreduce(tensor, average=None, name: Optional[str] = None, op=None):
                 f"reference tensorflow/__init__.py:67-78; got op="
                 f"{wire.reduce_op_name(red)}.")
         return _sparse.allreduce(tensor, average=red == ReduceOp.AVERAGE,
-                                 name=name)
+                                 name=name, process_set=process_set)
     return synchronize(allreduce_async(tensor, average=average, name=name,
-                                       op=op))
+                                       op=op, process_set=process_set))
 
 
-def allgather(tensor, name: Optional[str] = None):
+def allgather(tensor, name: Optional[str] = None, process_set=None):
     """Synchronous allgather along dim 0, rank order."""
-    return synchronize(allgather_async(tensor, name=name))
+    return synchronize(allgather_async(tensor, name=name,
+                                       process_set=process_set))
 
 
-def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set=None):
     """Synchronous broadcast from ``root_rank``."""
-    return synchronize(broadcast_async(tensor, root_rank, name=name))
+    return synchronize(broadcast_async(tensor, root_rank, name=name,
+                                       process_set=process_set))

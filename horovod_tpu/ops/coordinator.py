@@ -281,14 +281,16 @@ class PyCoordinator:
                     break
 
         if error is not None:
-            return Response(ResponseType.ERROR, [name], error_message=error)
+            return Response(ResponseType.ERROR, [name], error_message=error,
+                            process_set_id=first.process_set_id)
         self._resp_dtype[name] = first.tensor_type
         devices = [r.device for r in reqs]
         # dtype + shape ride every data response so joined ranks can
         # build zero contributions (hvd.join); BROADCAST also carries
         # its root in tensor_sizes (a joined rank has no local op).
         common = dict(devices=devices, tensor_type=first.tensor_type,
-                      tensor_shapes=[tuple(first.tensor_shape)])
+                      tensor_shapes=[tuple(first.tensor_shape)],
+                      process_set_id=first.process_set_id)
         if op == RequestType.ALLREDUCE:
             return Response(ResponseType.ALLREDUCE, [name],
                             reduce_op=first.reduce_op, **common)
@@ -311,6 +313,21 @@ class PyCoordinator:
             release, self._join_release = self._join_release, []
             ready, self.ready = self.ready, []
             responses = [self._construct_response_locked(n) for n in ready]
+        def nbytes_of(resp: Response) -> int:
+            # Prefer the queue-side size table; fall back to the
+            # shape × dtype the response itself carries (a process set
+            # excluding the controller has no entries in ITS queue, and
+            # an unbounded fallback of 0 would defeat the threshold).
+            got = sizes_bytes.get(resp.tensor_names[0])
+            if got is not None:
+                return got
+            shape = resp.tensor_shapes[0] if resp.tensor_shapes else ()
+            n = 1
+            for d in shape:
+                n *= int(d)
+            return n * wire.dtype_size(self._resp_dtype.get(
+                resp.tensor_names[0], DataType.FLOAT32))
+
         fused: List[Response] = list(withdrawn)
         i = 0
         while i < len(responses):
@@ -322,7 +339,7 @@ class PyCoordinator:
                 # scale adaptations, not elementwise reductions.
                 fused.append(r)
                 continue
-            total = sizes_bytes.get(r.tensor_names[0], 0)
+            total = nbytes_of(r)
             dtype = self._resp_dtype.get(r.tensor_names[0])
             j = i
             while j < len(responses):
@@ -330,12 +347,13 @@ class PyCoordinator:
                 if (nxt.response_type == ResponseType.ALLREDUCE
                         and nxt.devices == r.devices
                         and nxt.reduce_op == r.reduce_op
+                        and nxt.process_set_id == r.process_set_id
                         and self._resp_dtype.get(nxt.tensor_names[0]) == dtype
-                        and total + sizes_bytes.get(nxt.tensor_names[0], 0)
+                        and total + nbytes_of(nxt)
                         <= self.fusion_threshold):
+                    total += nbytes_of(nxt)
                     r.tensor_names.extend(nxt.tensor_names)
                     r.tensor_shapes.extend(nxt.tensor_shapes)
-                    total += sizes_bytes.get(nxt.tensor_names[0], 0)
                     responses.pop(j)
                 else:
                     j += 1

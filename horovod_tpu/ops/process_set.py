@@ -1,0 +1,113 @@
+"""Process sets: collectives over a subset of ranks.
+
+≙ the post-v0.13 Horovod process-set API (``hvd.add_process_set`` +
+the ``process_set=`` argument on collectives); the v0.13 reference
+fixes every collective to MPI_COMM_WORLD.  On the TPU *static* path a
+process set is just a mesh over a device subset (any ``shard_map`` over
+a sub-``Mesh``); this module gives the *dynamic* (eager) path the same
+capability: per-set negotiation through a per-set coordinator on the
+controller, per-set sub-mesh execution, and cross-rank registration
+validation.
+
+Rank-number convention: a set is declared with GLOBAL rank numbers
+(sorted, deduplicated); on the wire and in the coordinator the set's
+members are re-indexed 0..k-1 (set-local), so readiness counting, stall
+reports and allgather size tables keep their rank-table shape.
+Broadcast ``root_rank`` is likewise the GLOBAL rank at the API and
+translated to set-local internally — matching Horovod's convention.
+
+Restrictions (each documented at the raise site): a non-member may not
+submit into a set; ``hvd.join()`` interoperates with the GLOBAL set
+only; single-process set collectives take replicated values or
+per-member lists (a globally-sharded per-replica array has no canonical
+sub-slicing).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from ..core import state as _state
+
+
+class ProcessSet:
+    """A registered subset of ranks (``process_set_id`` 0 = global).
+
+    ``ranks`` are GLOBAL rank numbers: replica indices in
+    single-process mode, process ranks in multi-process mode.
+    """
+
+    def __init__(self, process_set_id: int, ranks: Tuple[int, ...]):
+        self.process_set_id = process_set_id
+        self.ranks = tuple(sorted(ranks))
+        # Controller-side per-set coordinator (set by add_process_set).
+        self.coordinator = None
+        self._mesh_kernels = None
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def included(self) -> bool:
+        """Is the calling process a member?  Always True single-process
+        (the one host drives every replica)."""
+        st = _state.global_state()
+        if not st.multiprocess:
+            return True
+        return st.process_index in self.ranks
+
+    def rank(self) -> int:
+        """The caller's SET-LOCAL index, or -1 if not a member."""
+        st = _state.global_state()
+        if not st.multiprocess:
+            return 0
+        try:
+            return self.ranks.index(st.process_index)
+        except ValueError:
+            return -1
+
+    def local_rank_of(self, global_rank: int) -> int:
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            raise ValueError(
+                f"rank {global_rank} is not a member of process set "
+                f"{self.process_set_id} (ranks {list(self.ranks)})"
+            ) from None
+
+    # -- execution mesh ----------------------------------------------------
+    def mesh_and_kernels(self):
+        """The set's sub-mesh + jitted collective kernels, built lazily.
+
+        Single-process: the member replicas' devices.  Multi-process:
+        one device per member process (the lowest-id local device, the
+        same convention as the global process mesh).
+        """
+        if self._mesh_kernels is None:
+            import jax
+
+            from . import collective as C
+
+            st = _state.global_state()
+            if st.multiprocess:
+                by_proc: Dict[int, object] = {}
+                for d in jax.devices():
+                    if (d.process_index not in by_proc
+                            or d.id < by_proc[d.process_index].id):
+                        by_proc[d.process_index] = d
+                devs = [by_proc[p] for p in self.ranks]
+            else:
+                devs = [st.devices[r] for r in self.ranks]
+            # Cached by device tuple: identical subsets share the ~20
+            # jitted kernels instead of recompiling per ProcessSet.
+            self._mesh_kernels = C._subset_kernels(tuple(devs))
+        return self._mesh_kernels
+
+    def close(self) -> None:
+        if self.coordinator is not None:
+            self.coordinator.close()
+            self.coordinator = None
+        self._mesh_kernels = None
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"ProcessSet(id={self.process_set_id}, "
+                f"ranks={list(self.ranks)})")

@@ -33,7 +33,8 @@ class IndexedSlices(NamedTuple):
     dense_shape: Tuple[int, ...]
 
 
-def allreduce(slices, average: bool = True, name: Optional[str] = None):
+def allreduce(slices, average: bool = True, name: Optional[str] = None,
+              process_set=None):
     """Allreduce an :class:`IndexedSlices` by gathering values + indices
     from every replica (≙ tensorflow/__init__.py:67-78).
 
@@ -43,25 +44,33 @@ def allreduce(slices, average: bool = True, name: Optional[str] = None):
     IndexedSlices holding the union of all contributions, with values
     divided by the replica count when ``average`` (the reference divides
     the gathered values the same way, tensorflow/__init__.py:75-77).
+    With ``process_set`` the gather and the averaging denominator cover
+    only the set's members.
     """
     from . import collective as C
     from ..core import state as _state
 
-    name = name or C._auto_name("sparse_allreduce")
+    name = name or C._auto_name("sparse_allreduce", process_set)
     if isinstance(slices, IndexedSlices):
-        values = C.allgather(slices.values, name=f"{name}.values")
-        indices = C.allgather(slices.indices, name=f"{name}.indices")
+        values = C.allgather(slices.values, name=f"{name}.values",
+                             process_set=process_set)
+        indices = C.allgather(slices.indices, name=f"{name}.indices",
+                              process_set=process_set)
         dense_shape = slices.dense_shape
     else:
         per = list(slices)
         if not per:
             raise ValueError("empty sparse allreduce")
-        values = C.allgather([s.values for s in per], name=f"{name}.values")
+        values = C.allgather([s.values for s in per], name=f"{name}.values",
+                             process_set=process_set)
         indices = C.allgather([s.indices for s in per],
-                              name=f"{name}.indices")
+                              name=f"{name}.indices",
+                              process_set=process_set)
         dense_shape = per[0].dense_shape
     if average:
-        values = values / _state.contributor_count()
+        denom = (_state.contributor_count() if process_set is None
+                 else process_set.size())
+        values = values / denom
     return IndexedSlices(values=values, indices=indices,
                          dense_shape=dense_shape)
 

@@ -39,9 +39,10 @@ FRAME_RESPONSES = 2   # controller→worker: packed response list
 FRAME_TOPO = 3        # controller→worker: <iiii> local_rank local_size
                       #                           cross_rank cross_size
 FRAME_SHUTDOWN = 4    # either direction: cooperative shutdown
-FRAME_WITHDRAW = 5    # worker→controller: <i rank><H len><name> — the
-                      # rank's synchronize timed out on <name>; the
-                      # coordinator fails the op for the whole group
+FRAME_WITHDRAW = 5    # worker→controller: <i rank><H len><name><H psid> —
+                      # the rank's synchronize timed out on <name>; the
+                      # coordinator (of process set psid; 0 = global)
+                      # fails the op for the whole group
 
 _HDR = struct.Struct("<IB")
 
@@ -111,6 +112,9 @@ class ControllerTransport:
         self.lost_ranks: set = set()
         self._closing = False
         self._conns: Dict[int, socket.socket] = {}
+        # Requests whose process set was not yet registered on arrival
+        # (registration race): retried by flush_unrouted.
+        self._unrouted: List = []
         self._lock = threading.Lock()
         self._send_lock = threading.Lock()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -189,25 +193,75 @@ class ControllerTransport:
                 return
             if ftype == FRAME_REQUEST:
                 req, _ = Request.unpack(payload)
-                try:
-                    self.coordinator.submit(req)
-                except ValueError:
-                    # Duplicate-name submissions are a caller bug on the
-                    # worker; it learns via its own synchronize timeout.
-                    pass
+                if not self._try_submit(req):
+                    # Registration race: the worker's set request can
+                    # arrive before the controller's own add_process_set
+                    # finishes.  Never block THIS receive thread (later
+                    # frames — withdraw, shutdown — must not queue
+                    # behind an orphan); the drain loop retries via
+                    # flush_unrouted with a bounded lifetime.
+                    with self._lock:
+                        self._unrouted.append(
+                            (time.monotonic() + 5.0, req))
             elif ftype == FRAME_SHUTDOWN:
                 self.shutdown_requested.set()
             elif ftype == FRAME_WITHDRAW:
                 (wrank,) = struct.unpack_from("<i", payload)
                 (nlen,) = struct.unpack_from("<H", payload, 4)
                 name = payload[6:6 + nlen].decode("utf-8")
+                psid = 0
+                if len(payload) >= 8 + nlen:
+                    (psid,) = struct.unpack_from("<H", payload, 6 + nlen)
                 # The next drain tick broadcasts the resulting ERROR
                 # response to every rank (including the withdrawer).
-                self.coordinator.withdraw(name, wrank)
+                coord = self._route_coord(psid)
+                if coord is not None:
+                    coord.withdraw(name, wrank)
+
+    def _route_coord(self, psid: int):
+        """Coordinator for a process-set id (0 = global); None when the
+        set is not (yet) registered on this controller."""
+        if psid == 0:
+            return self.coordinator
+        from ..core import state as _st
+
+        ps = _st.global_state().process_sets.get(psid)
+        return None if ps is None else ps.coordinator
+
+    def _try_submit(self, req: Request) -> bool:
+        coord = self._route_coord(req.process_set_id)
+        if coord is None:
+            return False
+        try:
+            coord.submit(req)
+        except ValueError:
+            # Duplicate-name submissions are a caller bug on the
+            # worker; it learns via its own synchronize timeout.
+            pass
+        return True
+
+    def flush_unrouted(self) -> None:
+        """Retry buffered requests whose process set was unknown when
+        they arrived (called from the drain loop each tick).  Requests
+        past their lifetime are dropped — the submitter's stall/withdraw
+        path reports the op."""
+        with self._lock:
+            if not self._unrouted:
+                return
+            items, self._unrouted = self._unrouted, []
+        now = time.monotonic()
+        keep = [(dl, req) for dl, req in items
+                if not self._try_submit(req) and now < dl]
+        if keep:
+            with self._lock:
+                self._unrouted = keep + self._unrouted
 
     # -- controller-side API used by the drain loop ------------------------
     def submit(self, req: Request) -> None:
-        self.coordinator.submit(req)
+        if not self._try_submit(req):
+            raise RuntimeError(
+                f"process set {req.process_set_id} is not registered on "
+                f"the controller")
 
     def broadcast_responses(self, responses: List[Response]) -> None:
         payload = wire.pack_response_list(responses)
@@ -343,14 +397,16 @@ class WorkerTransport:
         with self._send_lock:
             _send_frame(self._sock, FRAME_SHUTDOWN)
 
-    def withdraw(self, name: str) -> None:
+    def withdraw(self, name: str, process_set_id: int = 0) -> None:
         """Tell the controller this rank gave up waiting on ``name`` (its
-        synchronize timed out); the coordinator fails the op group-wide."""
+        synchronize timed out); the coordinator of ``process_set_id``
+        fails the op group-wide."""
         nb = name.encode("utf-8")
         with self._send_lock:
             _send_frame(self._sock, FRAME_WITHDRAW,
                         struct.pack("<i", self.rank)
-                        + struct.pack("<H", len(nb)) + nb)
+                        + struct.pack("<H", len(nb)) + nb
+                        + struct.pack("<H", process_set_id))
 
     def poll_responses(self) -> Optional[List[Response]]:
         """Next broadcast response list, or None if nothing arrived."""

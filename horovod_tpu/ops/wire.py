@@ -176,13 +176,18 @@ class Request:
     # ALLREDUCE only (ALLGATHER/BROADCAST ignore it): the reduction
     # operator, validated for cross-rank agreement by the coordinator.
     reduce_op: ReduceOp = ReduceOp.AVERAGE
+    # Process set this op negotiates within (post-v0.13 hvd process
+    # sets; 0 = the global set).  request_rank/root_rank are SET-LOCAL
+    # indices for non-global sets, so readiness counting, stall
+    # reporting and allgather size ordering stay rank-table-shaped.
+    process_set_id: int = 0
 
     def pack(self) -> bytes:
         name_b = self.tensor_name.encode("utf-8")
         out = struct.pack(
-            "<BBiiiBH", int(self.request_type), int(self.tensor_type),
+            "<BBiiiBHH", int(self.request_type), int(self.tensor_type),
             self.request_rank, self.root_rank, self.device,
-            int(self.reduce_op), len(name_b))
+            int(self.reduce_op), self.process_set_id, len(name_b))
         out += name_b
         out += struct.pack("<B", len(self.tensor_shape))
         for d in self.tensor_shape:
@@ -191,9 +196,9 @@ class Request:
 
     @staticmethod
     def unpack(buf: bytes, off: int = 0) -> Tuple["Request", int]:
-        rt, tt, rank, root, dev, rop, nlen = struct.unpack_from(
-            "<BBiiiBH", buf, off)
-        off += struct.calcsize("<BBiiiBH")
+        rt, tt, rank, root, dev, rop, psid, nlen = struct.unpack_from(
+            "<BBiiiBHH", buf, off)
+        off += struct.calcsize("<BBiiiBHH")
         name = buf[off:off + nlen].decode("utf-8")
         off += nlen
         (ndim,) = struct.unpack_from("<B", buf, off)
@@ -201,7 +206,7 @@ class Request:
         dims = struct.unpack_from(f"<{ndim}q", buf, off) if ndim else ()
         off += 8 * ndim
         return Request(rank, RequestType(rt), DataType(tt), name, root, dev,
-                       tuple(dims), ReduceOp(rop)), off
+                       tuple(dims), ReduceOp(rop), psid), off
 
 
 @dataclass
@@ -223,6 +228,9 @@ class Response:
     # ALLREDUCE: the validated reduction operator (fusion groups are
     # homogeneous in it; joined ranks execute from it).
     reduce_op: ReduceOp = ReduceOp.AVERAGE
+    # Process set the response belongs to (0 = global); a joined rank
+    # skips non-global responses it holds no ops for.
+    process_set_id: int = 0
 
     def pack(self) -> bytes:
         out = struct.pack("<BH", int(self.response_type), len(self.tensor_names))
@@ -245,6 +253,7 @@ class Response:
             for d in shape:
                 out += struct.pack("<q", d)
         out += struct.pack("<B", int(self.reduce_op))
+        out += struct.pack("<H", self.process_set_id)
         return out
 
     @staticmethod
@@ -282,9 +291,11 @@ class Response:
             shapes.append(tuple(dims))
         (rop,) = struct.unpack_from("<B", buf, off)
         off += 1
+        (psid,) = struct.unpack_from("<H", buf, off)
+        off += 2
         return Response(ResponseType(rt), names, err, devices, sizes,
                         None if tt == 255 else DataType(tt), shapes,
-                        ReduceOp(rop)), off
+                        ReduceOp(rop), psid), off
 
 
 def pack_response_list(responses: List[Response]) -> bytes:

@@ -524,6 +524,74 @@ def scenario_join(hvd):
     print(f"JOIN_OK rank={rank}")
 
 
+def scenario_process_sets(hvd):
+    """Process sets across REAL processes (post-v0.13 API; the v0.13
+    reference fixes everything to MPI_COMM_WORLD): np=3, set {0,2}
+    negotiates and executes over its own sub-mesh while rank 1 runs a
+    disjoint singleton set, then everyone meets again in a global op.
+    Registration is collective and validated; a non-member submit
+    raises."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import HorovodError
+
+    rank, size = hvd.rank(), hvd.size()
+    assert size == 3, size
+    ps = hvd.add_process_set([0, 2])
+    assert ps.included() == (rank in (0, 2))
+    if ps.included():
+        out = hvd.allreduce(jnp.full((2,), float(rank + 1)),
+                            average=False, process_set=ps, name="ps.sum")
+        np.testing.assert_allclose(np.asarray(out), 4.0)  # ranks 0+2: 1+3
+        out = hvd.allreduce(jnp.full((2,), float(rank + 1)),
+                            average=True, process_set=ps, name="ps.avg")
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+        # Ragged allgather inside the set: member m contributes m+1 rows.
+        mine = jnp.full((ps.rank() + 1, 2), float(rank))
+        g = np.asarray(hvd.allgather(mine, process_set=ps,
+                                     name="ps.gather"))
+        assert g.shape == (3, 2), g.shape
+        np.testing.assert_allclose(g[:1], 0.0)
+        np.testing.assert_allclose(g[1:], 2.0)
+        # Broadcast rooted at GLOBAL rank 2 (set-local 1).
+        out = hvd.broadcast(jnp.full((2,), float(rank)), 2,
+                            process_set=ps, name="ps.bcast")
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+    else:
+        try:
+            hvd.allreduce(jnp.ones((2,)), process_set=ps, name="ps.bad")
+            raise AssertionError("non-member submit did not raise")
+        except HorovodError as e:
+            assert "not a member" in str(e), str(e)
+    # A second, disjoint set keeps its own coordinator and sub-mesh.
+    ps1 = hvd.add_process_set([1])
+    if rank == 1:
+        out = hvd.allreduce(jnp.array([5.0]), average=False,
+                            process_set=ps1, name="ps1.solo")
+        np.testing.assert_allclose(np.asarray(out), 5.0)
+    # AUTO-NAMED ops: set members consumed set-namespaced names, so an
+    # unnamed GLOBAL op right after must still agree across ALL ranks
+    # (review finding: a shared counter would desync members from
+    # non-members and stall/misroute here).
+    if ps.included():
+        out = hvd.allreduce(jnp.ones((2,)), average=False, process_set=ps)
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+    out = hvd.allreduce(jnp.full((2,), 2.0), average=False)  # unnamed
+    np.testing.assert_allclose(np.asarray(out), 2.0 * size)
+    # Chaining a set output into a global collective re-places it.
+    if ps.included():
+        chained = hvd.allreduce(jnp.ones((2,)), average=False,
+                                process_set=ps, name="ps.chain")
+    else:
+        chained = jnp.full((2,), 2.0)
+    out = hvd.allreduce(chained, average=False, name="ps.chain.world")
+    np.testing.assert_allclose(np.asarray(out), 6.0)
+    # And the global set still works for everyone afterwards.
+    out = hvd.allreduce(jnp.ones((2,)), average=False, name="ps.world")
+    np.testing.assert_allclose(np.asarray(out), float(size))
+    print(f"PSETS_OK rank={rank}")
+
+
 def scenario_elastic(hvd):
     """Elastic relaunch across REAL processes: rank 1 dies hard at step
     5 of the first incarnation; rank 0 diagnoses the dead peer, exits

@@ -135,6 +135,16 @@ def test_clean_exit_without_shutdown_is_cooperative():
 
 
 @pytest.mark.slow
+def test_process_sets_three_processes():
+    """Process sets over REAL processes: subset negotiation via per-set
+    coordinators on the controller, sub-mesh execution, collective
+    registration, non-member rejection, coexistence with global ops."""
+    out = _launch("process_sets", np_=3, timeout=300.0)
+    for r in range(3):
+        assert f"PSETS_OK rank={r}" in out, out
+
+
+@pytest.mark.slow
 def test_elastic_relaunch_resumes_from_commit(tmp_path):
     """Elastic mode end-to-end: rank 1 dies hard at step 5; the
     --elastic launcher relaunches; the job resumes from the last commit

@@ -1,0 +1,151 @@
+"""Process sets (post-v0.13 ``hvd.add_process_set`` + ``process_set=``;
+the v0.13 reference fixes every collective to MPI_COMM_WORLD).
+Single-process legs over the 8-replica CPU mesh; the cross-process legs
+live in tests/test_multiprocess.py::test_process_sets_three_processes.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_process_set_registration_and_identity(hvd):
+    ps = hvd.add_process_set([5, 0, 2, 2])  # dedup + sort
+    assert ps.ranks == (0, 2, 5)
+    assert ps.size() == 3
+    assert ps.included()
+    assert isinstance(ps, hvd.ProcessSet)
+    ps2 = hvd.add_process_set([1, 3])
+    assert ps2.process_set_id == ps.process_set_id + 1
+    with pytest.raises(ValueError, match="outside"):
+        hvd.add_process_set([0, 99])
+    with pytest.raises(ValueError, match="at least one"):
+        hvd.add_process_set([])
+
+
+def test_subset_allreduce_denominators(hvd):
+    """Sum multiplies by the SET size, average divides by it — the set,
+    not the world, is the communicator (Horovod's semantics)."""
+    ps = hvd.add_process_set([0, 1, 2])
+    x = jnp.array([2.0])
+    assert float(hvd.allreduce(x, average=False, process_set=ps)[0]) == 6.0
+    assert float(hvd.allreduce(x, average=True, process_set=ps)[0]) == 2.0
+    assert float(hvd.allreduce(x, op=hvd.Product,
+                               process_set=ps)[0]) == 8.0
+    # Adasum needs a power-of-two SET size, regardless of world size.
+    with pytest.raises(ValueError, match="power-of-two"):
+        hvd.allreduce(x, op=hvd.Adasum, process_set=ps)
+    ps4 = hvd.add_process_set([0, 1, 2, 3])
+    assert float(hvd.allreduce(x, op=hvd.Adasum,
+                               process_set=ps4)[0]) == pytest.approx(2.0)
+
+
+def test_subset_ragged_allgather_and_broadcast(hvd):
+    ps = hvd.add_process_set([1, 4, 6])
+    out = np.asarray(hvd.allgather(
+        [jnp.full((i + 1, 2), float(i)) for i in range(3)],
+        process_set=ps))
+    assert out.shape == (6, 2)
+    np.testing.assert_allclose(out[0], 0.0)
+    np.testing.assert_allclose(out[1:3], 1.0)
+    np.testing.assert_allclose(out[3:], 2.0)
+    # Broadcast root is the GLOBAL rank number (Horovod's convention).
+    out = hvd.broadcast(jnp.arange(4.0), 6, process_set=ps)
+    np.testing.assert_allclose(np.asarray(out), [0, 1, 2, 3])
+    with pytest.raises(ValueError, match="not a member"):
+        hvd.broadcast(jnp.ones(2), 3, process_set=ps)
+
+
+def test_subset_rejects_global_per_replica_shard(hvd):
+    ps = hvd.add_process_set([0, 1])
+    with pytest.raises(ValueError, match="sub-slicing"):
+        hvd.allreduce(hvd.shard(jnp.ones((8, 2))), process_set=ps)
+
+
+def test_subset_and_global_ops_interleave(hvd):
+    """Set and world collectives share the queue and the drain loop but
+    negotiate in separate coordinators — async handles from both resolve
+    correctly."""
+    ps = hvd.add_process_set([0, 3])
+    h_set = hvd.allreduce_async(jnp.array([1.0]), average=False,
+                                process_set=ps, name="mix.set")
+    h_world = hvd.allreduce_async(jnp.array([1.0]), average=False,
+                                  name="mix.world")
+    assert float(hvd.synchronize(h_world)[0]) == float(hvd.size())
+    assert float(hvd.synchronize(h_set)[0]) == 2.0
+
+
+def test_subset_wire_roundtrip():
+    from horovod_tpu.ops.wire import (DataType, ReduceOp, Request,
+                                      RequestType, Response, ResponseType)
+
+    r = Request(1, RequestType.ALLREDUCE, DataType.FLOAT32, "x",
+                tensor_shape=(3,), reduce_op=ReduceOp.MAX,
+                process_set_id=7)
+    r2, _ = Request.unpack(r.pack())
+    assert r2 == r
+    resp = Response(ResponseType.ALLREDUCE, ["x"], process_set_id=7)
+    resp2, _ = Response.unpack(resp.pack())
+    assert resp2.process_set_id == 7
+
+
+def test_set_output_chains_into_global_collective(hvd):
+    """A set collective's output fed into a global one (and vice versa)
+    must be re-placed, not crash with an incompatible-devices error
+    (review finding: users naturally chain across communicators)."""
+    ps = hvd.add_process_set([0, 1, 2])
+    out = hvd.allreduce(jnp.array([1.0]), average=False, process_set=ps)
+    world = hvd.allreduce(out, average=False)
+    assert float(world[0]) == 3.0 * hvd.size()
+    back = hvd.allreduce(world, average=True, process_set=ps)
+    assert float(back[0]) == 3.0 * hvd.size()
+
+
+def test_sparse_allreduce_respects_process_set(hvd):
+    """IndexedSlices + process_set gathers over the SET and divides by
+    the SET size (review finding: it silently ran global before)."""
+    from horovod_tpu import IndexedSlices
+    from horovod_tpu.ops.sparse import as_dense
+
+    ps = hvd.add_process_set([0, 1, 2])
+    sl = IndexedSlices(jnp.ones((2, 3)), jnp.array([0, 1]), (4, 3))
+    out = hvd.allreduce(sl, average=False, process_set=ps)
+    assert out.values.shape[0] == 2 * ps.size()  # 6 set rows, not 16
+    dense = np.asarray(as_dense(out))
+    np.testing.assert_allclose(dense[:2], 3.0)
+    np.testing.assert_allclose(dense[2:], 0.0)
+    out = hvd.allreduce(sl, average=True, process_set=ps)
+    np.testing.assert_allclose(np.asarray(as_dense(out))[:2], 1.0)
+
+
+def test_auto_names_namespaced_per_set(hvd):
+    """Unnamed set ops consume a set-scoped counter, leaving the global
+    counter untouched (review finding: desync across ranks otherwise)."""
+    from horovod_tpu.ops.collective import _auto_name
+
+    ps = hvd.add_process_set([0, 1])
+    g1 = _auto_name("allreduce")
+    s1 = _auto_name("allreduce", ps)
+    g2 = _auto_name("allreduce")
+    assert s1.startswith(f"ps{ps.process_set_id}.allreduce.noname.")
+    # The global counter advanced by exactly one despite the set op.
+    assert int(g2.rsplit(".", 1)[1]) == int(g1.rsplit(".", 1)[1]) + 1
+
+
+def test_set_fusion_sizes_fall_back_to_shapes(make_coord=None):
+    """A set coordinator polled with an empty size table (the controller
+    is not a member, so ITS queue has no entries) still enforces the
+    fusion threshold via shape-derived sizes (review finding)."""
+    from horovod_tpu.ops.coordinator import PyCoordinator
+    from horovod_tpu.ops.wire import (DataType, Request, RequestType)
+
+    c = PyCoordinator(1, 100)  # threshold 100 bytes
+    # Derived sizes: a=60B, b=60B (can't join a: 120 > 100), c=20B
+    # (joins a: 80 <= 100).
+    for name, dim in (("a", 15), ("b", 15), ("c", 5)):
+        c.submit(Request(0, RequestType.ALLREDUCE, DataType.FLOAT32,
+                         name, tensor_shape=(dim,), process_set_id=3))
+    resps = c.poll_responses({})  # empty size table
+    assert all(r.process_set_id == 3 for r in resps)
+    groups = sorted(sorted(r.tensor_names) for r in resps)
+    assert groups == [["a", "c"], ["b"]], groups
