@@ -54,8 +54,18 @@ from ..core.state import (cross_rank, cross_size, init,  # noqa: F401
                           mpi_threads_supported, rank, shutdown, size)
 from ..ops import collective as _C
 from ..ops import sparse as _S
-from ..ops.collective import join  # noqa: F401  (hvd.join barrier)
+from ..ops.collective import (  # noqa: F401  (post-v0.13 API surface)
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    add_process_set,
+    join,
+)
 from ..ops.compression import Compression  # noqa: F401  (hvd.Compression)
+from ..ops.process_set import ProcessSet  # noqa: F401
 from ..ops.objects import (allgather_object,  # noqa: F401  (object API)
                            broadcast_object)
 

@@ -44,8 +44,18 @@ from ..core import state as _state
 from ..core.state import (init, is_initialized, local_rank, local_size,  # noqa: F401
                           mpi_threads_supported, rank, shutdown, size)
 from ..ops import collective as _C
-from ..ops.collective import join  # noqa: F401  (hvd.join barrier)
+from ..ops.collective import (  # noqa: F401  (post-v0.13 API surface)
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    add_process_set,
+    join,
+)
 from ..ops.compression import Compression  # noqa: F401  (hvd.Compression)
+from ..ops.process_set import ProcessSet  # noqa: F401
 from ..ops.objects import (allgather_object,  # noqa: F401  (object API)
                            broadcast_object)
 
@@ -202,31 +212,37 @@ def synchronize(handle: int) -> torch.Tensor:
 # -- allreduce --------------------------------------------------------------
 
 def allreduce_async(tensor, average=None, name: Optional[str] = None,
-                    compression=None, op=None) -> int:
+                    compression=None, op=None, process_set=None) -> int:
     return _enqueue("allreduce", tensor, inplace=False, name=name,
-                    compression=compression, average=average, op=op)
+                    compression=compression, average=average, op=op,
+                    process_set=process_set)
 
 
 def allreduce_async_(tensor, average=None, name: Optional[str] = None,
-                     compression=None, op=None) -> int:
+                     compression=None, op=None, process_set=None) -> int:
     return _enqueue("allreduce", tensor, inplace=True, name=name,
-                    compression=compression, average=average, op=op)
+                    compression=compression, average=average, op=op,
+                    process_set=process_set)
 
 
 def allreduce(tensor, average=None, name: Optional[str] = None,
-              compression=None, op=None) -> torch.Tensor:
+              compression=None, op=None,
+              process_set=None) -> torch.Tensor:
     """``compression`` (``hvd.Compression.fp16``/``bf16``) casts the
     tensor down for the wire and restores its dtype after; ``op`` takes
-    hvd.Average/Sum/Adasum/Min/Max/Product and supersedes ``average`` —
-    both kwarg contracts Horovod later standardized for this API."""
+    hvd.Average/Sum/Adasum/Min/Max/Product and supersedes ``average``;
+    ``process_set`` (from ``add_process_set``) restricts the collective
+    to a rank subset — the kwarg contracts Horovod later standardized
+    for this API."""
     return synchronize(allreduce_async(tensor, average, name, compression,
-                                       op))
+                                       op, process_set))
 
 
 def allreduce_(tensor, average=None, name: Optional[str] = None,
-               compression=None, op=None) -> torch.Tensor:
+               compression=None, op=None,
+               process_set=None) -> torch.Tensor:
     return synchronize(allreduce_async_(tensor, average, name, compression,
-                                        op))
+                                        op, process_set))
 
 
 def _grouped_allreduce_async(tensors, *, inplace: bool, average,
