@@ -56,6 +56,8 @@ from .ops.collective import (  # noqa: F401
     grouped_allreduce_async,
     join,
     poll,
+    reducescatter,
+    reducescatter_async,
     shard,
     synchronize,
 )

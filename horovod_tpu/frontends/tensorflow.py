@@ -124,8 +124,8 @@ def _wrap(out, like: np.ndarray):
     return tf.constant(np.asarray(out).astype(like.dtype, copy=False))
 
 
-def _allreduce_in_graph(tensor, average: bool, name: Optional[str],
-                        compression):
+def _allreduce_in_graph(tensor, average, name: Optional[str],
+                        compression, op=None, process_set=None):
     """tf.function branch of :func:`allreduce`: one py_function node per
     collective, name fixed at trace time (≙ the reference's per-TF-op
     names, mpi_ops.cc:270-298)."""
@@ -154,17 +154,19 @@ def _allreduce_in_graph(tensor, average: bool, name: Optional[str],
         return tf.IndexedSlices(vals, idxs,
                                 dense_shape=tensor.dense_shape)
 
-    op_name = name or _C._auto_name("allreduce.tf.fn")
+    op_name = name or _C._auto_name("allreduce.tf.fn", process_set)
     dt = tensor.dtype
 
     def _eager(t):
         arr = t.numpy()
         if compression is None:
-            out = _C.allreduce(arr, average=average, name=op_name)
+            out = _C.allreduce(arr, average=average, name=op_name, op=op,
+                               process_set=process_set)
         else:
             wire, ctx = compression.compress(arr)
             out = compression.decompress(
-                _C.allreduce(wire, average=average, name=op_name), ctx)
+                _C.allreduce(wire, average=average, name=op_name, op=op,
+                             process_set=process_set), ctx)
         return np.asarray(out).astype(dt.as_numpy_dtype, copy=False)
 
     (out,) = _graph_bridge(_eager, [tensor], [dt], op_name)
@@ -172,15 +174,17 @@ def _allreduce_in_graph(tensor, average: bool, name: Optional[str],
     return out
 
 
-def allreduce(tensor, average: bool = True, name: Optional[str] = None,
-              compression=None):
+def allreduce(tensor, average=None, name: Optional[str] = None,
+              compression=None, op=None, process_set=None):
     """Allreduce a ``tf.Tensor``/``tf.Variable``/``tf.IndexedSlices``.
 
     IndexedSlices dispatch to the sparse gather-of-(values, indices)
     exchange exactly like the reference (tensorflow/__init__.py:67-78);
     they already ship a minimal payload, so ``compression`` (the dense
     wire cast, ``hvd.Compression.fp16``/``bf16``) applies to dense
-    tensors only.
+    tensors only.  ``op`` (hvd.Average/Sum/Adasum/Min/Max/Product,
+    superseding ``average``) and ``process_set`` carry the post-v0.13
+    contracts; sparse inputs accept sum/average only.
 
     Inside ``tf.function`` the collective becomes a ``tf.py_function``
     bridge node executing the same eager queue path mid-graph (see the
@@ -188,8 +192,14 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
     """
     tf = _tf()
     if _tracing():
-        return _allreduce_in_graph(tensor, average, name, compression)
+        return _allreduce_in_graph(tensor, average, name, compression,
+                                   op=op, process_set=process_set)
     if isinstance(tensor, tf.IndexedSlices):
+        red_op = _C._resolve_op(average, op)
+        if red_op not in (_C.Average, _C.Sum):
+            raise ValueError(
+                "sparse (IndexedSlices) allreduce supports only "
+                "sum/average.")
         # dense_shape may legally be None; the exchange never needs it
         # (it only gathers values + indices, like the reference).
         dense_shape = (None if tensor.dense_shape is None
@@ -199,16 +209,19 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
         red = _S.allreduce(
             _S.IndexedSlices(values=values, indices=indices,
                              dense_shape=dense_shape or ()),
-            average=average, name=name)
+            average=red_op == _C.Average, name=name,
+            process_set=process_set)
         return tf.IndexedSlices(
             _wrap(red.values, values), _wrap(red.indices, indices),
             dense_shape=None if dense_shape is None
             else tf.constant(dense_shape, dtype="int64"))
     arr = _to_numpy(tensor)
     if compression is None:
-        return _wrap(_C.allreduce(arr, average=average, name=name), arr)
+        return _wrap(_C.allreduce(arr, average=average, name=name, op=op,
+                                  process_set=process_set), arr)
     wire, ctx = compression.compress(arr)
-    red = _C.allreduce(wire, average=average, name=name)
+    red = _C.allreduce(wire, average=average, name=name, op=op,
+                       process_set=process_set)
     return _wrap(compression.decompress(red, ctx), arr)
 
 

@@ -245,6 +245,20 @@ def allreduce_(tensor, average=None, name: Optional[str] = None,
                                         op, process_set))
 
 
+def reducescatter_async(tensor, average=None, name: Optional[str] = None,
+                        op=None, process_set=None) -> int:
+    return _enqueue("reducescatter", tensor, inplace=False, name=name,
+                    average=average, op=op, process_set=process_set)
+
+
+def reducescatter(tensor, average=None, name: Optional[str] = None,
+                  op=None, process_set=None) -> torch.Tensor:
+    """The post-v0.13 ``hvd.reducescatter``: reduce across ranks, split
+    dim 0 — this rank receives its chunk (op ∈ {Average, Sum})."""
+    return synchronize(reducescatter_async(tensor, average, name, op,
+                                           process_set))
+
+
 def _grouped_allreduce_async(tensors, *, inplace: bool, average,
                              name: Optional[str], compression,
                              op=None) -> list:

@@ -46,6 +46,7 @@ const char* OpName(RequestType t) {
     case RequestType::kAllgather: return "allgather";
     case RequestType::kBroadcast: return "broadcast";
     case RequestType::kJoin: return "join";
+    case RequestType::kReducescatter: return "reducescatter";
   }
   return "?";
 }
@@ -160,9 +161,30 @@ class Coordinator {
         }
       }
     }
+    // Reducescatter (post-v0.13): full shape agreement; never completes
+    // via joins (the joined rank must participate for its chunk).
+    if (error.empty() && op == RequestType::kReducescatter) {
+      for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
+        const Request& r = p.requests[i];
+        if (r.tensor_shape != first.tensor_shape) {
+          std::ostringstream os;
+          os << "Mismatched reducescatter tensor shapes: One rank sent a "
+             << "tensor of shape " << ShapeStr(first.tensor_shape)
+             << ", but another rank sent a tensor of shape "
+             << ShapeStr(r.tensor_shape) << ".";
+          error = os.str();
+        }
+      }
+      if (error.empty() && static_cast<int>(p.requests.size()) < size_) {
+        error = "Reducescatter cannot complete after a rank has joined: "
+                "every rank must participate to receive its chunk of the "
+                "result.";
+      }
+    }
     // Reduce-op agreement (post-v0.13 hvd op= API; v0.13 hard-codes
     // MPI_SUM).  Must stay message-identical with ops/coordinator.py.
-    if (error.empty() && op == RequestType::kAllreduce) {
+    if (error.empty() && (op == RequestType::kAllreduce ||
+                          op == RequestType::kReducescatter)) {
       for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
         const Request& r = p.requests[i];
         if (r.reduce_op != first.reduce_op) {
@@ -174,7 +196,8 @@ class Coordinator {
           error = os.str();
         }
       }
-      if (error.empty() && static_cast<int>(p.requests.size()) < size_ &&
+      if (error.empty() && op == RequestType::kAllreduce &&
+          static_cast<int>(p.requests.size()) < size_ &&
           first.reduce_op != ReduceOp::kSum &&
           first.reduce_op != ReduceOp::kAverage) {
         std::ostringstream os;
@@ -289,6 +312,10 @@ class Coordinator {
     switch (op) {
       case RequestType::kAllreduce:
         resp.response_type = ResponseType::kAllreduce;
+        resp.reduce_op = first.reduce_op;
+        break;
+      case RequestType::kReducescatter:
+        resp.response_type = ResponseType::kReducescatter;
         resp.reduce_op = first.reduce_op;
         break;
       case RequestType::kAllgather:

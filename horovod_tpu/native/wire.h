@@ -26,10 +26,12 @@ int DataTypeSize(DataType t);  // bytes per element (≙ wire.dtype_size)
 // ≙ MPIRequestType / MPIResponseType (mpi_message.h); JOIN is the
 // post-v0.13 uneven-workload barrier (see ops/wire.py).
 enum class RequestType : uint8_t { kAllreduce = 0, kAllgather = 1,
-                                   kBroadcast = 2, kJoin = 3 };
+                                   kBroadcast = 2, kJoin = 3,
+                                   kReducescatter = 4 };
 enum class ResponseType : uint8_t { kAllreduce = 0, kAllgather = 1,
                                     kBroadcast = 2, kError = 3, kDone = 4,
-                                    kShutdown = 5, kJoin = 6 };
+                                    kShutdown = 5, kJoin = 6,
+                                    kReducescatter = 7 };
 
 // Allreduce reduction operator (post-v0.13 Horovod op= API; the v0.13
 // reference hard-codes MPI_SUM).  ≙ ops/wire.py ReduceOp.

@@ -358,6 +358,18 @@ def _build_kernels(mesh):
 
     n = mesh.shape[REPLICA_AXIS]
 
+    def _rscatter_pr_block(x):
+        # Per-replica [n, d0, ...]: reduce then keep this replica's
+        # dim-0 chunk (the post-v0.13 hvd.reducescatter semantics) —
+        # XLA's native ReduceScatter over ICI, not a psum + slice.
+        v = jnp.squeeze(x, axis=0)
+        return jax.lax.psum_scatter(v, REPLICA_AXIS, scatter_dimension=0,
+                                    tiled=True)[None]
+
+    def _rscatter_rep_block(x):
+        return jax.lax.psum_scatter(x, REPLICA_AXIS, scatter_dimension=0,
+                                    tiled=True)[None]
+
     def _prod_all(x):
         # No lax.pprod exists: gather every contribution and reduce
         # locally (XLA fuses the pointwise product into the gather's
@@ -440,6 +452,13 @@ def _build_kernels(mesh):
         "bcast_pr": jax.jit(jax.shard_map(
             _bcast_block, mesh=mesh, in_specs=(P(REPLICA_AXIS), P()),
             out_specs=P(), check_vma=False)),
+        # Reducescatter: per-replica [n, d0, ...] -> per-replica
+        # [n, d0/n, ...] (row r = rank r's chunk of the reduction).
+        "rscatter_pr": sm(_rscatter_pr_block, P(REPLICA_AXIS),
+                          P(REPLICA_AXIS), check_vma=False),
+        # Replicated [d0, ...] -> per-replica [n, d0/n, ...].
+        "rscatter_rep": sm(_rscatter_rep_block, P(), P(REPLICA_AXIS),
+                           check_vma=False),
     }
 
 
@@ -750,6 +769,21 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
                 hm._get(o.handle).result = piece
         return
 
+    if resp.response_type == ResponseType.REDUCESCATTER:
+        ks = _mesh_kernels() if ps is None else ps.mesh_and_kernels()[1]
+        for o in ops:  # never fused: each op owns its chunk layout
+            if tl: tl.start(o.name, "REDUCESCATTER")
+            if tl: tl.activity_start(o.name, "XLA_REDUCESCATTER")
+            kernel = ks["rscatter_pr" if o.contrib.per_replica
+                        else "rscatter_rep"]
+            out = kernel(o.contrib.value)
+            if o.red_op == ReduceOp.AVERAGE:
+                out = _divide(out, denom)
+            if tl: tl.activity_end(o.name)
+            if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
+            hm._get(o.handle).result = out
+        return
+
     if resp.response_type == ResponseType.ALLGATHER:
         ks = _mesh_kernels() if ps is None else ps.mesh_and_kernels()[1]
         for o in ops:
@@ -894,6 +928,22 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
             if tl: tl.activity_end(o.name)
             if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
             hm._get(o.handle).result = piece
+        return
+
+    if resp.response_type == ResponseType.REDUCESCATTER:
+        for o in ops:
+            if tl: tl.start(o.name, "REDUCESCATTER")
+            if tl: tl.activity_start(o.name, "XLA_REDUCESCATTER")
+            res = ks["rscatter_pr"](_mp_global(o.contrib.value, ps))
+            # This process's chunk: its addressable row of the P(A)
+            # output (Horovod returns only the caller's chunk).
+            mine = jnp.squeeze(jnp.asarray(res.addressable_data(0)),
+                               axis=0)
+            if o.red_op == ReduceOp.AVERAGE:
+                mine = _divide(mine, denom)
+            if tl: tl.activity_end(o.name)
+            if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
+            hm._get(o.handle).result = mine
         return
 
     if resp.response_type == ResponseType.ALLGATHER:
@@ -1122,7 +1172,7 @@ def _resolve_op(average, op) -> ReduceOp:
     if op is not None:
         if average is not None:
             raise ValueError(
-                "allreduce: specify either average= or op=, not both "
+                "specify either average= or op=, not both "
                 "(op supersedes average).")
         return ReduceOp(op)
     if average is None or average:
@@ -1226,6 +1276,47 @@ def allgather_async(tensor, name: Optional[str] = None,
                     process_set=None) -> int:
     return _enqueue(tensor, RequestType.ALLGATHER, name, prefix="allgather",
                     process_set=process_set)
+
+
+def reducescatter_async(tensor, average=None, name: Optional[str] = None,
+                        op=None, process_set=None) -> int:
+    """Queue a reducescatter (the post-v0.13 ``hvd.reducescatter``):
+    reduce across ranks, then split dim 0 — rank r receives chunk r.
+    Multi-process mode returns only the caller's chunk;
+    single-process mode returns the per-replica stack ``[n, d0/n, ...]``
+    (row r = replica r's chunk).  ``op`` ∈ {Average, Sum}."""
+    red = _resolve_op(average, op)
+    if red not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"reducescatter supports op=Average/Sum (Horovod's contract "
+            f"for this collective); got {wire.reduce_op_name(red)}.")
+    if isinstance(tensor, (list, tuple)):
+        raise ValueError(
+            "reducescatter takes one tensor (identical shape on every "
+            "rank), not a list.")
+    n = (_state.contributor_count() if process_set is None
+         else process_set.size())
+    shape = tuple(jnp.shape(tensor))
+    # is_per_replica can only be True for an already-sharded jax.Array —
+    # don't transfer host inputs to device just to learn that.
+    if _state.global_state().multiprocess or not (
+            isinstance(tensor, jax.Array) and is_per_replica(tensor)):
+        d0 = shape[0] if shape else 0
+    else:
+        d0 = shape[1] if len(shape) > 1 else 0  # [n, d0, ...] shard
+    if not shape or d0 % n != 0 or d0 == 0:
+        raise ValueError(
+            f"reducescatter needs dim 0 divisible by the rank count "
+            f"({n}); got shape {list(shape)}.")
+    return _enqueue(tensor, RequestType.REDUCESCATTER, name, red_op=red,
+                    prefix="reducescatter", process_set=process_set)
+
+
+def reducescatter(tensor, average=None, name: Optional[str] = None,
+                  op=None, process_set=None):
+    """Synchronous reducescatter — see :func:`reducescatter_async`."""
+    return synchronize(reducescatter_async(tensor, average, name, op,
+                                           process_set))
 
 
 def broadcast_async(tensor, root_rank: int,

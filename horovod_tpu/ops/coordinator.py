@@ -190,9 +190,26 @@ class PyCoordinator:
                              f"sent a tensor of shape "
                              f"{list(r.tensor_shape)}.")
                     break
-        # Allreduce: reduce-op agreement (post-v0.13 hvd op= API; no
-        # reference analogue — v0.13 hard-codes MPI_SUM).
-        if error is None and op == RequestType.ALLREDUCE:
+        # Reducescatter (post-v0.13): full shape agreement like
+        # allreduce, and it can never complete via joins — the joined
+        # rank must participate to receive its own chunk.
+        if error is None and op == RequestType.REDUCESCATTER:
+            for r in reqs[1:]:
+                if r.tensor_shape != first.tensor_shape:
+                    error = (f"Mismatched reducescatter tensor shapes: One "
+                             f"rank sent a tensor of shape "
+                             f"{list(first.tensor_shape)}, but another rank "
+                             f"sent a tensor of shape "
+                             f"{list(r.tensor_shape)}.")
+                    break
+            if error is None and len(reqs) < self.size:
+                error = ("Reducescatter cannot complete after a rank has "
+                         "joined: every rank must participate to receive "
+                         "its chunk of the result.")
+        # Allreduce/reducescatter: reduce-op agreement (post-v0.13 hvd
+        # op= API; no reference analogue — v0.13 hard-codes MPI_SUM).
+        if error is None and op in (RequestType.ALLREDUCE,
+                                    RequestType.REDUCESCATTER):
             for r in reqs[1:]:
                 if r.reduce_op != first.reduce_op:
                     error = (f"Mismatched reduce operations: One rank "
@@ -201,7 +218,8 @@ class PyCoordinator:
                              f"another rank specified reduce op "
                              f"{wire.reduce_op_name(r.reduce_op)}.")
                     break
-            if error is None and len(reqs) < self.size and \
+            if error is None and op == RequestType.ALLREDUCE \
+                    and len(reqs) < self.size and \
                     first.reduce_op not in (wire.ReduceOp.SUM,
                                             wire.ReduceOp.AVERAGE):
                 # Completed via joins: a joined rank's zero contribution
@@ -293,6 +311,9 @@ class PyCoordinator:
                       process_set_id=first.process_set_id)
         if op == RequestType.ALLREDUCE:
             return Response(ResponseType.ALLREDUCE, [name],
+                            reduce_op=first.reduce_op, **common)
+        if op == RequestType.REDUCESCATTER:
+            return Response(ResponseType.REDUCESCATTER, [name],
                             reduce_op=first.reduce_op, **common)
         if op == RequestType.ALLGATHER:
             return Response(ResponseType.ALLGATHER, [name],

@@ -107,12 +107,14 @@ def dtype_size(dt: DataType) -> int:
 class RequestType(IntEnum):
     """≙ MPIRequestType (mpi_message.h), plus JOIN — the post-v0.13
     Horovod barrier for uneven workloads (a rank out of data declares it
-    will contribute zeros to every remaining collective)."""
+    will contribute zeros to every remaining collective) — and
+    REDUCESCATTER (post-v0.13: reduce, then split dim 0 across ranks)."""
 
     ALLREDUCE = 0
     ALLGATHER = 1
     BROADCAST = 2
     JOIN = 3
+    REDUCESCATTER = 4
 
 
 class ReduceOp(IntEnum):
@@ -147,6 +149,7 @@ class ResponseType(IntEnum):
     DONE = 4
     SHUTDOWN = 5
     JOIN = 6
+    REDUCESCATTER = 7
 
 
 # Device id of a host-resident tensor (≙ CPU_DEVICE_ID, common.h:28).

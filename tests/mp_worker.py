@@ -86,6 +86,16 @@ def scenario_basic(hvd):
     except _HErr as e:
         assert "Mismatched reduce operations" in str(e), str(e)
 
+    # Reducescatter across REAL processes (post-v0.13): each rank gets
+    # its own chunk of the reduction — here, half of sum_r(arange+r).
+    out = hvd.reducescatter(_jnp.arange(4.0) + rank, average=False,
+                            name="red.rscatter")
+    want = (2.0 * np.arange(4.0) + 1.0)[2 * rank:2 * rank + 2]
+    np.testing.assert_allclose(np.asarray(out), want)
+    out = hvd.reducescatter(_jnp.arange(4.0) + rank, average=True,
+                            name="red.rscatter.avg")
+    np.testing.assert_allclose(np.asarray(out), want / 2.0)
+
     # Object collectives across REAL processes: per-rank pickles of
     # genuinely different sizes ride the ragged allgather; broadcast
     # ships the root's object to the non-root.

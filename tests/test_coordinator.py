@@ -167,7 +167,7 @@ def test_py_native_response_parity_fuzz():
     dtypes = [DataType.FLOAT32, DataType.INT32, DataType.BFLOAT16,
               DataType.UINT32, DataType.UINT64]
     ops = [RequestType.ALLREDUCE, RequestType.ALLGATHER,
-           RequestType.BROADCAST]
+           RequestType.BROADCAST, RequestType.REDUCESCATTER]
     for trial in range(30):
         size = int(rng.randint(1, 5))
         py = PyCoordinator(size, int(rng.choice([0, 64, 1024, 1 << 20])))
@@ -393,3 +393,49 @@ def test_non_sum_allreduce_with_joined_rank_is_error(make_coord):
     resps = c2.poll_responses({"t2": 16})
     data = [r for r in resps if r.response_type != ResponseType.JOIN]
     assert data[0].response_type == ResponseType.ALLREDUCE
+
+
+def test_reducescatter_validation_both_impls(make_coord):
+    """Reducescatter (post-v0.13): shape and reduce-op mismatches get
+    the ERROR response from BOTH coordinator implementations, and a
+    matched pair yields a REDUCESCATTER response carrying the op."""
+    c = make_coord(2, 0)
+    c.submit(_req(0, "rs.shape", op=RequestType.REDUCESCATTER,
+                  shape=(8,)))
+    c.submit(_req(1, "rs.shape", op=RequestType.REDUCESCATTER,
+                  shape=(4,)))
+    (resp,) = c.poll_responses({})
+    assert resp.response_type == ResponseType.ERROR
+    assert "Mismatched reducescatter tensor shapes" in resp.error_message
+
+    c2 = make_coord(2, 0)
+    c2.submit(_req(0, "rs.op", op=RequestType.REDUCESCATTER,
+                   red=ReduceOp.SUM))
+    c2.submit(_req(1, "rs.op", op=RequestType.REDUCESCATTER,
+                   red=ReduceOp.AVERAGE))
+    (resp,) = c2.poll_responses({})
+    assert resp.response_type == ResponseType.ERROR
+    assert "Mismatched reduce operations" in resp.error_message
+
+    c3 = make_coord(2, 0)
+    c3.submit(_req(0, "rs.ok", op=RequestType.REDUCESCATTER,
+                   red=ReduceOp.AVERAGE))
+    c3.submit(_req(1, "rs.ok", op=RequestType.REDUCESCATTER,
+                   red=ReduceOp.AVERAGE))
+    (resp,) = c3.poll_responses({})
+    assert resp.response_type == ResponseType.REDUCESCATTER
+    assert resp.reduce_op == ReduceOp.AVERAGE
+
+
+def test_reducescatter_refuses_joined_completion(make_coord):
+    """A reducescatter completed via a join must error: the joined rank
+    cannot receive its chunk (both implementations)."""
+    c = make_coord(2, 0)
+    c.submit(_req(0, "hvd.join", op=RequestType.JOIN,
+                  dtype=DataType.UINT8))
+    c.submit(_req(1, "rs.joined", op=RequestType.REDUCESCATTER))
+    resps = c.poll_responses({})
+    data = [r for r in resps if r.response_type != ResponseType.JOIN]
+    assert data[0].response_type == ResponseType.ERROR
+    assert "cannot complete after a rank has joined" in \
+        data[0].error_message

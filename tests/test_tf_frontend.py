@@ -144,3 +144,30 @@ def test_indexed_slices_without_dense_shape(hvd):
     assert isinstance(out, tf.IndexedSlices)
     assert out.dense_shape is None
     assert out.indices.dtype == tf.int64
+
+
+def test_tf_allreduce_op_and_process_set(hvd):
+    """The post-v0.13 op= and process_set= kwargs work on the TF
+    surface (review finding: the constants were exported but no TF
+    collective accepted them)."""
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu.frontends.tensorflow as hvdtf
+
+    t = tf.constant([3.0, -1.0])
+    np.testing.assert_allclose(
+        hvdtf.allreduce(t, op=hvdtf.Min).numpy(), [3.0, -1.0])
+    np.testing.assert_allclose(
+        hvdtf.allreduce(tf.constant([2.0]), op=hvdtf.Product).numpy(),
+        [2.0 ** hvd.size()])
+    ps = hvdtf.add_process_set([0, 1])
+    np.testing.assert_allclose(
+        hvdtf.allreduce(tf.constant([2.0]), average=False,
+                        process_set=ps).numpy(), [4.0])
+    with pytest.raises(ValueError, match="not both"):
+        hvdtf.allreduce(t, average=True, op=hvdtf.Sum)
+
+    @tf.function
+    def f(x):
+        return hvdtf.allreduce(x, op=hvdtf.Max, name="tf.fn.max")
+
+    np.testing.assert_allclose(f(tf.constant([5.0])).numpy(), [5.0])
