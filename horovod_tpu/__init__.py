@@ -52,14 +52,31 @@ from .ops.collective import (  # noqa: F401
     allreduce_async,
     broadcast,
     broadcast_async,
+    grouped_allgather,
+    grouped_allgather_async,
     grouped_allreduce,
     grouped_allreduce_async,
+    grouped_reducescatter,
+    grouped_reducescatter_async,
     join,
     poll,
     reducescatter,
     reducescatter_async,
     shard,
     synchronize,
+)
+from .core.features import (  # noqa: F401  (build/feature query shims)
+    ccl_built,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    mpi_built,
+    mpi_enabled,
+    native_built,
+    nccl_built,
+    rocm_built,
+    xla_built,
 )
 from .ops.process_set import ProcessSet  # noqa: F401
 from .ops.wire import ReduceOp  # noqa: F401

@@ -1,0 +1,61 @@
+"""Build/runtime feature-query shims (≙ the post-v0.13 Horovod API:
+``hvd.mpi_built()``, ``hvd.nccl_built()``, ``hvd.gloo_built()``,
+``hvd.cuda_built()``, ``hvd.rocm_built()``, ``hvd.mpi_enabled()``, …).
+
+Migration scripts commonly branch on these to pick launch/tuning paths;
+honest answers keep those branches working: there is no MPI, NCCL,
+Gloo, CUDA or ROCm anywhere in this stack — the data plane is XLA
+collectives over ICI/DCN and the control plane is the TCP coordinator.
+``xla_built()``/``native_built()`` report what IS here.
+"""
+
+from __future__ import annotations
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """The TPU-native data plane: XLA collectives over the device mesh."""
+    return True
+
+
+def native_built() -> bool:
+    """True when the C++ coordinator/wire/timeline library is loaded
+    (falls back to the pure-Python twins otherwise)."""
+    from ..native import lib as _native
+
+    return bool(_native.NATIVE)

@@ -49,6 +49,8 @@ from typing import Any, Iterable, List, Optional
 import numpy as np
 
 from ..core import state as _state
+from ..core.features import (  # noqa: F401  (feature-query shims)
+    cuda_built, gloo_built, mpi_built, mpi_enabled, nccl_built, rocm_built)
 from ..core.state import (cross_rank, cross_size, init,  # noqa: F401
                           is_initialized, local_rank, local_size,
                           mpi_threads_supported, rank, shutdown, size)

@@ -41,6 +41,8 @@ import numpy as np
 import torch
 
 from ..core import state as _state
+from ..core.features import (  # noqa: F401  (feature-query shims)
+    cuda_built, gloo_built, mpi_built, mpi_enabled, nccl_built, rocm_built)
 from ..core.state import (init, is_initialized, local_rank, local_size,  # noqa: F401
                           mpi_threads_supported, rank, shutdown, size)
 from ..ops import collective as _C
@@ -359,6 +361,27 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
                                         name=f"broadcast.{name}"))
     for h in handles:
         synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Sync an optimizer's full state from ``root_rank`` (≙ the
+    post-v0.13 ``hvd.broadcast_optimizer_state``).
+
+    Redesign note: Horovod broadcasts each state tensor individually and
+    needs workarounds for lazily-created state (non-root ranks may not
+    have momentum buffers yet, so it fabricates them with a dummy step).
+    Here the whole ``state_dict`` rides ONE ``broadcast_object`` over
+    the ragged-allgather wire — arbitrary optimizer state (tensors,
+    scalars, per-group hyperparameters) with no lazy-init special case;
+    the pickled payload is a few model-sizes at most and this runs once
+    at startup/restore, not per step.
+    """
+    inner = optimizer
+    if isinstance(inner, _DistributedOptimizer):
+        inner = inner._inner
+    sd = broadcast_object(inner.state_dict(), root_rank=root_rank,
+                          name="broadcast.optimizer.state")
+    inner.load_state_dict(sd)
 
 
 class _DistributedOptimizer:

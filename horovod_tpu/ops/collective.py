@@ -1272,6 +1272,40 @@ def grouped_allreduce(tensors, average=None, name: Optional[str] = None,
             for h in grouped_allreduce_async(tensors, average, name, op)]
 
 
+def grouped_allgather_async(tensors, name: Optional[str] = None,
+                            process_set=None) -> List[int]:
+    """Queue a group of allgathers (≙ the post-v0.13
+    hvd.grouped_allgather): one handle per tensor, back-to-back enqueue
+    so every gather negotiates in the same coordinator tick."""
+    base = name or _auto_name("grouped.allgather", process_set)
+    return [_enqueue(t, RequestType.ALLGATHER, f"{base}.{i}",
+                     prefix="allgather", process_set=process_set)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_allgather(tensors, name: Optional[str] = None,
+                      process_set=None) -> List:
+    return [synchronize(h)
+            for h in grouped_allgather_async(tensors, name, process_set)]
+
+
+def grouped_reducescatter_async(tensors, average=None,
+                                name: Optional[str] = None, op=None,
+                                process_set=None) -> List[int]:
+    """Queue a group of reducescatters (≙ the post-v0.13
+    hvd.grouped_reducescatter): one handle per tensor."""
+    base = name or _auto_name("grouped.reducescatter", process_set)
+    return [reducescatter_async(t, average, f"{base}.{i}", op, process_set)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_reducescatter(tensors, average=None,
+                          name: Optional[str] = None, op=None,
+                          process_set=None) -> List:
+    return [synchronize(h) for h in grouped_reducescatter_async(
+        tensors, average, name, op, process_set)]
+
+
 def allgather_async(tensor, name: Optional[str] = None,
                     process_set=None) -> int:
     return _enqueue(tensor, RequestType.ALLGATHER, name, prefix="allgather",

@@ -234,6 +234,21 @@ def scenario_torch_frontend(hvd):
     want = (2.0 * np.mean([r + 1 for r in range(size)])) * 1.0
     np.testing.assert_allclose(model.weight.detach().numpy(), want,
                                rtol=1e-5)
+
+    # broadcast_optimizer_state across REAL processes: non-root starts
+    # with a divergent lr AND no momentum buffers; root's full
+    # state_dict (momentum included) must land.
+    m2 = nn.Linear(2, 1, bias=False)
+    o2 = torch.optim.SGD(m2.parameters(), lr=0.5, momentum=0.9)
+    if rank == 0:
+        ((m2(torch.ones(1, 2))).sum()).backward()
+        o2.step()  # creates the momentum buffer on root only
+    else:
+        o2.param_groups[0]["lr"] = 99.0
+    thvd.broadcast_optimizer_state(o2, root_rank=0)
+    assert o2.param_groups[0]["lr"] == 0.5, o2.param_groups[0]["lr"]
+    assert any("momentum_buffer" in st
+               for st in o2.state_dict()["state"].values())
     print(f"TORCH_OK rank={rank}")
 
 

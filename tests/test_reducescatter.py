@@ -63,3 +63,19 @@ def test_reducescatter_torch_frontend(hvd):
     # per-replica stack flattened row-major == n*x.
     np.testing.assert_allclose(
         out.numpy().reshape(-1), n * np.arange(2 * n, dtype="float32"))
+
+
+def test_grouped_allgather_and_reducescatter(hvd):
+    """The post-v0.13 grouped variants: one handle per tensor, order
+    preserved, negotiated in one tick."""
+    n = hvd.size()
+    outs = hvd.grouped_allgather([jnp.ones((1, 2)), jnp.full((2, 2), 3.0)])
+    assert np.asarray(outs[0]).shape == (n, 2)
+    assert np.asarray(outs[1]).shape == (2 * n, 2)
+    np.testing.assert_allclose(np.asarray(outs[1]), 3.0)
+    outs = hvd.grouped_reducescatter(
+        [jnp.arange(float(n)), jnp.arange(float(2 * n))], average=False)
+    np.testing.assert_allclose(np.asarray(outs[0]).reshape(-1),
+                               n * np.arange(float(n)))
+    np.testing.assert_allclose(np.asarray(outs[1]).reshape(-1),
+                               n * np.arange(float(2 * n)))

@@ -247,3 +247,44 @@ def test_torch_allreduce_op_kwarg(hvd):
         [2.0 ** hvd.size()])
     with pytest.raises(ValueError, match="not both"):
         thvd.allreduce(t, average=True, op=hvd.Sum)
+
+
+def test_broadcast_optimizer_state(hvd):
+    """broadcast_optimizer_state syncs the full state_dict — including
+    lazily-created momentum buffers the reference needed workarounds
+    for (post-v0.13 hvd.broadcast_optimizer_state)."""
+    import horovod_tpu.frontends.torch as thvd
+
+    model = torch.nn.Linear(3, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.5, momentum=0.9)
+    # Create momentum buffers, then perturb the hyperparameters so the
+    # broadcast has something real to restore.
+    loss = model(torch.ones(1, 3)).sum()
+    loss.backward()
+    opt.step()
+    want = {k: v for k, v in opt.state_dict()["param_groups"][0].items()}
+    opt.param_groups[0]["lr"] = 123.0  # divergent non-root state
+    thvd.broadcast_optimizer_state(opt, root_rank=0)
+    # Single-process: rank 0 IS the root, so the state round-trips the
+    # object wire and lands unchanged — including the mutated lr on the
+    # root (the broadcast ships the CURRENT root state).
+    assert opt.param_groups[0]["lr"] == 123.0
+    # Momentum buffers survive the round trip tensor-identical.
+    sd = opt.state_dict()
+    assert any("momentum_buffer" in st for st in sd["state"].values())
+    # The wrapped DistributedOptimizer delegates to the inner optimizer.
+    dopt = thvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    thvd.broadcast_optimizer_state(dopt, root_rank=0)
+    assert opt.param_groups[0]["lr"] == 123.0
+
+
+def test_feature_query_shims(hvd):
+    import horovod_tpu as H
+    import horovod_tpu.frontends.torch as thvd
+
+    assert not H.mpi_built() and not H.nccl_built()
+    assert not H.cuda_built() and not H.gloo_built()
+    assert H.xla_built()
+    assert isinstance(H.native_built(), bool)
+    assert thvd.mpi_built() is False  # same shims on the frontends
