@@ -54,6 +54,43 @@ class MnistMLP(nn.Module):
         return x
 
 
+class MnistBNMLP(nn.Module):
+    """Dense net with BatchNorm — the smallest model carrying non-trained
+    state (running mean/var), for the stateful training-step variants
+    (synchronized BatchNorm) without a conv stack's compile cost."""
+
+    num_classes: int = 10
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes)(x)
+        return x
+
+
+def bn_mlp_loss_fn(model: nn.Module):
+    """``loss_fn(params, model_state, batch) -> (loss, new_state)`` for
+    the stateful step builders."""
+    def loss_fn(params, model_state, batch):
+        images, labels = batch
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": model_state}, images,
+            train=True, mutable=["batch_stats"])
+        return cross_entropy_loss(logits, labels), updates["batch_stats"]
+    return loss_fn
+
+
+def init_bn_mlp(model: nn.Module, batch_size: int = 8, seed: int = 0):
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((batch_size, 28, 28, 1), jnp.float32)
+    variables = model.init(rng, dummy, train=False)
+    return variables["params"], variables["batch_stats"]
+
+
 def cross_entropy_loss(logits, labels):
     """Mean softmax cross-entropy over the (local) batch."""
     logp = jax.nn.log_softmax(logits)
