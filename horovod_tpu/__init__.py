@@ -50,6 +50,9 @@ from .ops.collective import (  # noqa: F401
     allgather_async,
     allreduce,
     allreduce_async,
+    alltoall,
+    alltoall_async,
+    barrier,
     broadcast,
     broadcast_async,
     grouped_allgather,

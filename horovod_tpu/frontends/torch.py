@@ -247,6 +247,23 @@ def allreduce_(tensor, average=None, name: Optional[str] = None,
                                         op, process_set))
 
 
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set=None):
+    """The post-v0.13 ``hvd.alltoall``: scatter this rank's dim-0 rows
+    by ``splits`` and receive every rank's rows in rank order.
+    Multi-process returns the caller's received rows; single-process
+    returns a list of per-replica tensors."""
+    arr = _to_numpy(tensor)
+    out = _C.alltoall(arr, splits=splits, name=name,
+                      process_set=process_set)
+    if isinstance(out, list):
+        return [_from_numpy(np.asarray(o), tensor.dtype) for o in out]
+    return _from_numpy(np.asarray(out), tensor.dtype)
+
+
+barrier = _C.barrier  # post-v0.13 hvd.barrier
+
+
 def reducescatter_async(tensor, average=None, name: Optional[str] = None,
                         op=None, process_set=None) -> int:
     return _enqueue("reducescatter", tensor, inplace=False, name=name,

@@ -47,6 +47,7 @@ const char* OpName(RequestType t) {
     case RequestType::kBroadcast: return "broadcast";
     case RequestType::kJoin: return "join";
     case RequestType::kReducescatter: return "reducescatter";
+    case RequestType::kAlltoall: return "alltoall";
   }
   return "?";
 }
@@ -247,6 +248,69 @@ class Coordinator {
         }
       }
     }
+    // Alltoall (post-v0.13): trailing-dim agreement; per-rank splits
+    // must cover dim 0; never completes via joins.  tensor_sizes will
+    // carry the full split matrix row-major by sender.  Must stay
+    // message-identical with ops/coordinator.py.
+    std::vector<int64_t> alltoall_sizes;
+    if (error.empty() && op == RequestType::kAlltoall) {
+      if (first.tensor_shape.empty())
+        error = "An alltoall tensor needs at least one dimension.";
+      for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
+        const Request& r = p.requests[i];
+        bool trailing_ok =
+            r.tensor_shape.size() == first.tensor_shape.size() &&
+            std::equal(r.tensor_shape.begin() + 1, r.tensor_shape.end(),
+                       first.tensor_shape.begin() + 1);
+        if (!trailing_ok) {
+          std::ostringstream os;
+          os << "Mismatched alltoall tensor shapes: One rank sent a tensor "
+             << "of shape " << ShapeStr(first.tensor_shape)
+             << ", but another rank sent a tensor of shape "
+             << ShapeStr(r.tensor_shape) << ".";
+          error = os.str();
+        }
+      }
+      if (error.empty() && static_cast<int>(p.requests.size()) < size_) {
+        error = "Alltoall cannot complete after a rank has joined: every "
+                "rank must both send and receive.";
+      }
+      if (error.empty()) {
+        for (const Request& r : p.requests) {
+          int64_t d0 = r.tensor_shape[0];
+          if (r.splits.empty()) {
+            if (d0 % size_ != 0) {
+              std::ostringstream os;
+              os << "Alltoall without splits needs dim 0 divisible by the "
+                 << "rank count (" << size_ << "); rank " << r.request_rank
+                 << " sent " << d0 << " rows.";
+              error = os.str();
+              break;
+            }
+            for (int i = 0; i < size_; ++i)
+              alltoall_sizes.push_back(d0 / size_);
+          } else {
+            int64_t total = 0;
+            bool neg = false;
+            for (int64_t s : r.splits) {
+              total += s;
+              if (s < 0) neg = true;
+            }
+            if (static_cast<int>(r.splits.size()) != size_ || total != d0 ||
+                neg) {
+              std::ostringstream os;
+              os << "Invalid alltoall splits from rank " << r.request_rank
+                 << ": " << ShapeStr(r.splits)
+                 << " must have one non-negative entry per rank (" << size_
+                 << ") summing to its dim 0 (" << d0 << ").";
+              error = os.str();
+              break;
+            }
+            for (int64_t s : r.splits) alltoall_sizes.push_back(s);
+          }
+        }
+      }
+    }
     if (error.empty() && op == RequestType::kBroadcast) {
       for (size_t i = 1; i < p.requests.size() && error.empty(); ++i) {
         const Request& r = p.requests[i];
@@ -317,6 +381,10 @@ class Coordinator {
       case RequestType::kReducescatter:
         resp.response_type = ResponseType::kReducescatter;
         resp.reduce_op = first.reduce_op;
+        break;
+      case RequestType::kAlltoall:
+        resp.response_type = ResponseType::kAlltoall;
+        resp.tensor_sizes = std::move(alltoall_sizes);
         break;
       case RequestType::kAllgather:
         resp.response_type = ResponseType::kAllgather;

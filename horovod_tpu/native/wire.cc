@@ -80,6 +80,8 @@ std::string Request::Pack() const {
   out.append(tensor_name);
   Append<uint8_t>(&out, static_cast<uint8_t>(tensor_shape.size()));
   for (int64_t d : tensor_shape) Append<int64_t>(&out, d);
+  Append<uint16_t>(&out, static_cast<uint16_t>(splits.size()));
+  for (int64_t s : splits) Append<int64_t>(&out, s);
   return out;
 }
 
@@ -104,6 +106,14 @@ ssize_t Request::Unpack(const uint8_t* buf, size_t len, Request* out) {
     int64_t d;
     if (!ReadLE(buf, len, &off, &d)) return -1;
     out->tensor_shape.push_back(d);
+  }
+  uint16_t nspl;
+  if (!ReadLE(buf, len, &off, &nspl)) return -1;
+  out->splits.clear();
+  for (uint16_t i = 0; i < nspl; ++i) {
+    int64_t s;
+    if (!ReadLE(buf, len, &off, &s)) return -1;
+    out->splits.push_back(s);
   }
   out->request_type = static_cast<RequestType>(rt);
   out->tensor_type = static_cast<DataType>(tt);

@@ -27,11 +27,11 @@ int DataTypeSize(DataType t);  // bytes per element (≙ wire.dtype_size)
 // post-v0.13 uneven-workload barrier (see ops/wire.py).
 enum class RequestType : uint8_t { kAllreduce = 0, kAllgather = 1,
                                    kBroadcast = 2, kJoin = 3,
-                                   kReducescatter = 4 };
+                                   kReducescatter = 4, kAlltoall = 5 };
 enum class ResponseType : uint8_t { kAllreduce = 0, kAllgather = 1,
                                     kBroadcast = 2, kError = 3, kDone = 4,
                                     kShutdown = 5, kJoin = 6,
-                                    kReducescatter = 7 };
+                                    kReducescatter = 7, kAlltoall = 8 };
 
 // Allreduce reduction operator (post-v0.13 Horovod op= API; the v0.13
 // reference hard-codes MPI_SUM).  ≙ ops/wire.py ReduceOp.
@@ -55,6 +55,8 @@ struct Request {
   uint16_t process_set_id = 0;
   std::string tensor_name;
   std::vector<int64_t> tensor_shape;
+  // ALLTOALL only: dim-0 rows sent to each destination (empty = even).
+  std::vector<int64_t> splits;
 
   std::string Pack() const;
   // Returns bytes consumed, or -1 on malformed input.

@@ -370,6 +370,15 @@ def _build_kernels(mesh):
         return jax.lax.psum_scatter(x, REPLICA_AXIS, scatter_dimension=0,
                                     tiled=True)[None]
 
+    def _a2a_block(x):
+        # Per-sender [n(dest), M, rest] blocks → per-receiver
+        # [n(sender), M, rest]: XLA's native AllToAll on ICI.  Ragged
+        # splits ride pad-to-max M (the split matrix is negotiated, so
+        # M is static at trace time), like the ragged allgather.
+        v = jnp.squeeze(x, axis=0)
+        return jax.lax.all_to_all(v, REPLICA_AXIS, split_axis=0,
+                                  concat_axis=0, tiled=False)[None]
+
     def _prod_all(x):
         # No lax.pprod exists: gather every contribution and reduce
         # locally (XLA fuses the pointwise product into the gather's
@@ -459,6 +468,10 @@ def _build_kernels(mesh):
         # Replicated [d0, ...] -> per-replica [n, d0/n, ...].
         "rscatter_rep": sm(_rscatter_rep_block, P(), P(REPLICA_AXIS),
                            check_vma=False),
+        # Alltoall: [n(sender), n(dest), M, ...] -> [n(recv), n(sender),
+        # M, ...] (padded blocks; the host slices by the split matrix).
+        "a2a_pr": sm(_a2a_block, P(REPLICA_AXIS), P(REPLICA_AXIS),
+                     check_vma=False),
     }
 
 
@@ -634,7 +647,8 @@ def _background_loop(stop_event: threading.Event) -> None:
 
 def _submit_requests(name: str, op: RequestType, c: _Contribution,
                      root_rank: int = -1,
-                     red_op: ReduceOp = ReduceOp.SUM, ps=None) -> None:
+                     red_op: ReduceOp = ReduceOp.SUM, ps=None,
+                     splits: Tuple[int, ...] = ()) -> None:
     st = _state.global_state()
     psid = 0 if ps is None else ps.process_set_id
     if st.timeline is not None:
@@ -650,7 +664,7 @@ def _submit_requests(name: str, op: RequestType, c: _Contribution,
             tensor_type=wire.dtype_of(c.dtype), tensor_name=name,
             root_rank=root_rank, device=c.devices[0],
             tensor_shape=c.shapes[0], reduce_op=red_op,
-            process_set_id=psid))
+            process_set_id=psid, splits=splits))
         return
     coord = st.coordinator if ps is None else ps.coordinator
     for r in range(st.size if ps is None else ps.size()):
@@ -659,7 +673,7 @@ def _submit_requests(name: str, op: RequestType, c: _Contribution,
             tensor_type=wire.dtype_of(c.dtype), tensor_name=name,
             root_rank=root_rank, device=c.devices[r],
             tensor_shape=c.shapes[r], reduce_op=red_op,
-            process_set_id=psid))
+            process_set_id=psid, splits=splits))
 
 
 def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
@@ -767,6 +781,45 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
                 if tl: tl.activity_end(o.name)
                 if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
                 hm._get(o.handle).result = piece
+        return
+
+    if resp.response_type == ResponseType.ALLTOALL:
+        ks = _mesh_kernels() if ps is None else ps.mesh_and_kernels()[1]
+        n = denom
+        matrix = np.asarray(resp.tensor_sizes,
+                            dtype=np.int64).reshape(n, n)
+        M = int(matrix.max()) if matrix.size else 0
+        for o in ops:
+            c = o.contrib
+            if tl: tl.start(o.name, "ALLTOALL")
+            if tl: tl.activity_start(o.name, "XLA_ALLTOALL")
+            rest = tuple(c.shapes[0][1:])
+            per_sender = (np.asarray(c.value) if c.per_replica
+                          else np.stack([np.asarray(c.value)] * n))
+            send = np.zeros((n, n, M) + rest, per_sender.dtype)
+            for s in range(n):
+                off = 0
+                for d in range(n):
+                    cnt = int(matrix[s, d])
+                    send[s, d, :cnt] = per_sender[s][off:off + cnt]
+                    off += cnt
+            if ps is None:
+                placed = shard(jnp.asarray(send))
+            else:
+                mesh_ps, _ = ps.mesh_and_kernels()
+                spec = [None] * send.ndim
+                spec[0] = REPLICA_AXIS
+                placed = jax.device_put(
+                    jnp.asarray(send), NamedSharding(mesh_ps, P(*spec)))
+            recv = np.asarray(ks["a2a_pr"](placed))  # [recv, sender, M,..]
+            outs = [
+                jnp.concatenate([recv[r, s, :int(matrix[s, r])]
+                                 for s in range(n)], axis=0)
+                for r in range(n)
+            ]
+            if tl: tl.activity_end(o.name)
+            if tl: tl.end(o.name, dtype=str(c.dtype))
+            hm._get(o.handle).result = outs
         return
 
     if resp.response_type == ResponseType.REDUCESCATTER:
@@ -928,6 +981,34 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
             if tl: tl.activity_end(o.name)
             if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
             hm._get(o.handle).result = piece
+        return
+
+    if resp.response_type == ResponseType.ALLTOALL:
+        st_me = (st.process_index if ps is None else ps.rank())
+        n = denom
+        matrix = np.asarray(resp.tensor_sizes,
+                            dtype=np.int64).reshape(n, n)
+        M = int(matrix.max()) if matrix.size else 0
+        for o in ops:
+            c = o.contrib
+            if tl: tl.start(o.name, "ALLTOALL")
+            if tl: tl.activity_start(o.name, "XLA_ALLTOALL")
+            rest = tuple(c.shapes[0][1:])
+            local = np.asarray(c.value)
+            send = np.zeros((n, M) + rest, local.dtype)
+            off = 0
+            for d in range(n):
+                cnt = int(matrix[st_me, d])
+                send[d, :cnt] = local[off:off + cnt]
+                off += cnt
+            res = ks["a2a_pr"](_mp_global(jnp.asarray(send), ps))
+            mine = np.asarray(res.addressable_data(0))[0]  # [sender, M,..]
+            out = jnp.concatenate(
+                [mine[s, :int(matrix[s, st_me])] for s in range(n)],
+                axis=0)
+            if tl: tl.activity_end(o.name)
+            if tl: tl.end(o.name, dtype=str(c.dtype))
+            hm._get(o.handle).result = out
         return
 
     if resp.response_type == ResponseType.REDUCESCATTER:
@@ -1202,7 +1283,7 @@ def _check_reduce_op(red_op: ReduceOp, dtype, process_set=None) -> None:
 def _enqueue(x, op: RequestType, name: Optional[str],
              red_op: ReduceOp = ReduceOp.SUM,
              root_rank: int = -1, prefix: str = "",
-             process_set=None) -> int:
+             process_set=None, splits: Tuple[int, ...] = ()) -> int:
     _state._check_initialized()
     st = _state.global_state()
     if st.peer_shutdown:
@@ -1226,7 +1307,10 @@ def _enqueue(x, op: RequestType, name: Optional[str],
     _queue.put(_QueuedOp(name=name, op=op, contrib=c, red_op=red_op,
                          root_rank=root_rank, handle=handle, nbytes=nbytes,
                          ps=process_set))
-    _submit_requests(name, op, c, root_rank, red_op=red_op, ps=process_set)
+    # The execute paths read split info from the NEGOTIATED response
+    # matrix, never from the local op — splits ride the request only.
+    _submit_requests(name, op, c, root_rank, red_op=red_op, ps=process_set,
+                     splits=tuple(splits))
     return handle
 
 
@@ -1310,6 +1394,65 @@ def allgather_async(tensor, name: Optional[str] = None,
                     process_set=None) -> int:
     return _enqueue(tensor, RequestType.ALLGATHER, name, prefix="allgather",
                     process_set=process_set)
+
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None,
+                   process_set=None) -> int:
+    """Queue an alltoall (the post-v0.13 ``hvd.alltoall``): rank r's
+    dim-0 rows are scattered to every rank by ``splits`` (one count per
+    destination; ``None`` = even split), and the rows received from all
+    ranks concatenate in rank order.
+
+    Multi-process mode returns the caller's received tensor;
+    single-process mode returns the LIST of per-replica received
+    tensors (row counts may differ per receiver).  The negotiated split
+    matrix rides the response, so ragged exchanges work like the ragged
+    allgather (pad-to-max around XLA's native AllToAll on ICI).
+    """
+    n = (_state.contributor_count() if process_set is None
+         else process_set.size())
+    if isinstance(tensor, (list, tuple)):
+        raise ValueError("alltoall takes one tensor per rank, not a list.")
+    shape = tuple(jnp.shape(tensor))
+    if not shape:
+        raise ValueError("An alltoall tensor needs at least one dimension.")
+    st = _state.global_state()
+    d0 = (shape[0] if (st.multiprocess or not (
+        isinstance(tensor, jax.Array) and is_per_replica(tensor)))
+        else (shape[1] if len(shape) > 1 else 0))
+    if splits is None:
+        if not shape or d0 % n != 0:
+            raise ValueError(
+                f"alltoall without splits needs dim 0 divisible by the "
+                f"rank count ({n}); got shape {list(shape)}.")
+        splits = ()
+    else:
+        splits = tuple(int(s) for s in splits)
+        if len(splits) != n or any(s < 0 for s in splits) or \
+                sum(splits) != d0:
+            raise ValueError(
+                f"alltoall splits {list(splits)} must have one "
+                f"non-negative entry per rank ({n}) summing to dim 0 "
+                f"({d0}).")
+    return _enqueue(tensor, RequestType.ALLTOALL, name, prefix="alltoall",
+                    process_set=process_set, splits=splits)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set=None):
+    """Synchronous alltoall — see :func:`alltoall_async`."""
+    return synchronize(alltoall_async(tensor, splits, name, process_set))
+
+
+def barrier(process_set=None) -> None:
+    """Block until every rank reaches the barrier (the post-v0.13
+    ``hvd.barrier``): one tiny named allreduce through the full
+    negotiation path, so it also surfaces peer failures/stalls like any
+    other collective."""
+    synchronize(allreduce_async(
+        np.zeros((1,), np.float32), average=False,
+        name=_auto_name("barrier", process_set),
+        process_set=process_set))
 
 
 def reducescatter_async(tensor, average=None, name: Optional[str] = None,

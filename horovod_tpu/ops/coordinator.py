@@ -260,6 +260,51 @@ class PyCoordinator:
                 # has joined).
                 by_rank = {r.request_rank: r.tensor_shape[0] for r in reqs}
                 tensor_sizes = [by_rank.get(r, 0) for r in range(self.size)]
+        # Alltoall (post-v0.13): trailing-dim agreement; each rank's
+        # splits must cover its own dim 0; never completes via joins
+        # (every rank both sends and receives).  The response's
+        # tensor_sizes carries the full split matrix, row-major by
+        # sender, so receivers know every incoming row count.
+        alltoall_sizes: List[int] = []
+        if error is None and op == RequestType.ALLTOALL:
+            if len(first.tensor_shape) == 0:
+                error = "An alltoall tensor needs at least one dimension."
+            for r in reqs[1:]:
+                if error:
+                    break
+                if len(r.tensor_shape) != len(first.tensor_shape) or \
+                        r.tensor_shape[1:] != first.tensor_shape[1:]:
+                    error = (f"Mismatched alltoall tensor shapes: One rank "
+                             f"sent a tensor of shape "
+                             f"{list(first.tensor_shape)}, but another "
+                             f"rank sent a tensor of shape "
+                             f"{list(r.tensor_shape)}.")
+            if error is None and len(reqs) < self.size:
+                error = ("Alltoall cannot complete after a rank has "
+                         "joined: every rank must both send and receive.")
+            if error is None:
+                for r in reqs:
+                    d0 = r.tensor_shape[0]
+                    if not r.splits:
+                        if d0 % self.size != 0:
+                            error = (f"Alltoall without splits needs dim 0 "
+                                     f"divisible by the rank count "
+                                     f"({self.size}); rank "
+                                     f"{r.request_rank} sent {d0} rows.")
+                            break
+                        row = [d0 // self.size] * self.size
+                    elif len(r.splits) != self.size or \
+                            sum(r.splits) != d0 or \
+                            any(s < 0 for s in r.splits):
+                        error = (f"Invalid alltoall splits from rank "
+                                 f"{r.request_rank}: {list(r.splits)} "
+                                 f"must have one non-negative entry per "
+                                 f"rank ({self.size}) summing to its dim "
+                                 f"0 ({d0}).")
+                        break
+                    else:
+                        row = list(r.splits)
+                    alltoall_sizes.extend(row)
         # Broadcast: root agreement + shape agreement
         # (operations.cc:396-431).
         if error is None and op == RequestType.BROADCAST:
@@ -315,6 +360,9 @@ class PyCoordinator:
         if op == RequestType.REDUCESCATTER:
             return Response(ResponseType.REDUCESCATTER, [name],
                             reduce_op=first.reduce_op, **common)
+        if op == RequestType.ALLTOALL:
+            return Response(ResponseType.ALLTOALL, [name],
+                            tensor_sizes=alltoall_sizes, **common)
         if op == RequestType.ALLGATHER:
             return Response(ResponseType.ALLGATHER, [name],
                             tensor_sizes=tensor_sizes, **common)

@@ -115,6 +115,7 @@ class RequestType(IntEnum):
     BROADCAST = 2
     JOIN = 3
     REDUCESCATTER = 4
+    ALLTOALL = 5
 
 
 class ReduceOp(IntEnum):
@@ -150,6 +151,7 @@ class ResponseType(IntEnum):
     SHUTDOWN = 5
     JOIN = 6
     REDUCESCATTER = 7
+    ALLTOALL = 8
 
 
 # Device id of a host-resident tensor (≙ CPU_DEVICE_ID, common.h:28).
@@ -184,6 +186,9 @@ class Request:
     # indices for non-global sets, so readiness counting, stall
     # reporting and allgather size ordering stay rank-table-shaped.
     process_set_id: int = 0
+    # ALLTOALL only: rows of dim 0 this rank sends to each destination
+    # (length = communicator size; empty = even split).
+    splits: Tuple[int, ...] = ()
 
     def pack(self) -> bytes:
         name_b = self.tensor_name.encode("utf-8")
@@ -195,6 +200,9 @@ class Request:
         out += struct.pack("<B", len(self.tensor_shape))
         for d in self.tensor_shape:
             out += struct.pack("<q", d)
+        out += struct.pack("<H", len(self.splits))
+        for s in self.splits:
+            out += struct.pack("<q", s)
         return out
 
     @staticmethod
@@ -208,8 +216,12 @@ class Request:
         off += 1
         dims = struct.unpack_from(f"<{ndim}q", buf, off) if ndim else ()
         off += 8 * ndim
+        (nspl,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        spl = struct.unpack_from(f"<{nspl}q", buf, off) if nspl else ()
+        off += 8 * nspl
         return Request(rank, RequestType(rt), DataType(tt), name, root, dev,
-                       tuple(dims), ReduceOp(rop), psid), off
+                       tuple(dims), ReduceOp(rop), psid, tuple(spl)), off
 
 
 @dataclass

@@ -96,6 +96,19 @@ def scenario_basic(hvd):
                             name="red.rscatter.avg")
     np.testing.assert_allclose(np.asarray(out), want / 2.0)
 
+    # Alltoall across REAL processes (post-v0.13), ragged splits: rank 0
+    # sends [1 row to 0, 2 rows to 1]; rank 1 sends [2, 1].  Receiver r
+    # concatenates in sender order.
+    mine = _jnp.asarray(np.arange(3.0).reshape(3, 1) + 100 * rank)
+    out = np.asarray(hvd.alltoall(mine,
+                                  splits=[1, 2] if rank == 0 else [2, 1],
+                                  name="red.a2a"))
+    if rank == 0:
+        np.testing.assert_allclose(out[:, 0], [0, 100, 101])
+    else:
+        np.testing.assert_allclose(out[:, 0], [1, 2, 102])
+    hvd.barrier()
+
     # Object collectives across REAL processes: per-rank pickles of
     # genuinely different sizes ride the ragged allgather; broadcast
     # ships the root's object to the non-root.

@@ -42,8 +42,9 @@ def make_coord(request):
 
 def _req(rank, name, shape=(4,), op=RequestType.ALLREDUCE,
          dtype=DataType.FLOAT32, root=-1, device=-1,
-         red=ReduceOp.AVERAGE):
-    return Request(rank, op, dtype, name, root, device, shape, red)
+         red=ReduceOp.AVERAGE, splits=()):
+    return Request(rank, op, dtype, name, root, device, shape, red, 0,
+                   splits)
 
 
 def test_readiness_counting(make_coord):
@@ -186,8 +187,9 @@ def test_py_native_response_parity_fuzz():
             root = int(rng.randint(0, size))
             for r in range(size):
                 shape, dt, red = base_shape, base_dtype, base_red
-                if op == RequestType.ALLGATHER and rng.rand() < 0.5:
-                    # Ragged dim 0 is legal for allgather (Allgatherv).
+                if op in (RequestType.ALLGATHER,
+                          RequestType.ALLTOALL) and rng.rand() < 0.5:
+                    # Ragged dim 0 is legal for allgather/alltoall.
                     shape = (int(rng.randint(1, 6)), shape[1])
                 if rng.rand() < 0.1:
                     shape = (shape[0], 4)
@@ -195,8 +197,18 @@ def test_py_native_response_parity_fuzz():
                     dt = dtypes[(dtypes.index(dt) + 1) % len(dtypes)]
                 if rng.rand() < 0.1:
                     red = ReduceOp((int(red) + 1) % 6)
+                splits = ()
+                if op == RequestType.ALLTOALL and rng.rand() < 0.6:
+                    # Valid or (10%) deliberately invalid splits.
+                    cuts = sorted(rng.randint(0, shape[0] + 1, size - 1)) \
+                        if size > 1 else []
+                    splits = tuple(
+                        b - a for a, b in zip([0] + list(cuts),
+                                              list(cuts) + [shape[0]]))
+                    if rng.rand() < 0.1:
+                        splits = splits + (1,)
                 py_req = _req(r, name, shape=shape, op=op, dtype=dt,
-                              root=root, red=red)
+                              root=root, red=red, splits=splits)
                 py.submit(py_req)
                 nat.submit(py_req)
         py_resps = py.poll_responses(sizes_bytes)
