@@ -257,6 +257,68 @@ def _cluster_reformable() -> bool:
     return True
 
 
+class TrainerState(State):
+    """Elastic state bound to a :class:`~horovod_tpu.frontends.loop.Trainer`
+    (≙ the reference-lineage framework State classes —
+    ``hvd.elastic.TorchState`` et al. — which snapshot a live
+    model/optimizer rather than raw values).
+
+    Captures the trainer's parameters, optimizer state, model state and
+    loop counters; :meth:`restore`/:meth:`sync` write them BACK into the
+    trainer, so ``@elastic.run`` functions can drive ``trainer.fit``
+    directly::
+
+        trainer = Trainer(loss_fn, params, ...)
+        state = elastic.TrainerState(trainer, epoch=0)
+
+        @elastic.run
+        def train(state):
+            trainer.fit(batches, epochs, steps,
+                        initial_epoch=state.epoch)
+            state.epoch = epochs
+            state.commit()
+
+    Works with every Trainer storage mode — under ``fsdp=True`` the
+    ``params`` property contract (read = gather, assign = re-shard)
+    makes the snapshot/restore transparent.
+    """
+
+    def __init__(self, trainer: Any, **extra: Any) -> None:
+        object.__setattr__(self, "_trainer", trainer)
+        values = dict(params=trainer.params, opt_state=trainer.opt_state,
+                      **extra)
+        if trainer.model_state is not None:
+            values["model_state"] = trainer.model_state
+        super().__init__(**values)
+
+    def _capture(self) -> None:
+        t = self._trainer
+        self._values["params"] = t.params
+        self._values["opt_state"] = t.opt_state
+        if t.model_state is not None:
+            self._values["model_state"] = t.model_state
+
+    def _install(self) -> None:
+        t = self._trainer
+        t.params = self._values["params"]
+        t.opt_state = self._values["opt_state"]
+        if "model_state" in self._values:
+            t.model_state = self._values["model_state"]
+
+    def commit(self) -> None:
+        self._capture()
+        super().commit()
+
+    def restore(self) -> None:
+        super().restore()
+        self._install()
+
+    def sync(self) -> None:
+        self._capture()
+        super().sync()
+        self._install()
+
+
 def run(func: Callable) -> Callable:
     """Decorator making a training function elastic (≙
     ``@hvd.elastic.run``).

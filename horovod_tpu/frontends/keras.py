@@ -51,7 +51,17 @@ from ..core.state import (cross_rank, cross_size, init,  # noqa: F401
                           is_initialized, local_rank, local_size,
                           mpi_threads_supported, rank, shutdown, size)
 from ..ops import collective as _C
+from ..ops.collective import (  # noqa: F401  (post-v0.13 API surface)
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    add_process_set,
+)
 from ..ops.compression import Compression  # noqa: F401  (hvd.Compression)
+from ..ops.process_set import ProcessSet  # noqa: F401
 from ..ops.objects import (allgather_object,  # noqa: F401  (object API)
                            broadcast_object)
 from ..parallel import data as _D
@@ -152,19 +162,25 @@ def broadcast_global_variables(model_or_variables, root_rank: int = 0):
         v.assign(np.asarray(_C.synchronize(h)))
 
 
-def allreduce(value, name: Optional[str] = None, average: bool = True):
-    """Allreduce a tensor-compatible value (≙ keras/__init__.py:105-118)."""
+def allreduce(value, name: Optional[str] = None, average=None, op=None,
+              process_set=None):
+    """Allreduce a tensor-compatible value (≙ keras/__init__.py:105-118);
+    ``op`` (hvd.Average/Sum/Adasum/Min/Max/Product, superseding
+    ``average``) and ``process_set`` carry the post-v0.13 contracts."""
     return np.asarray(_C.allreduce(np.asarray(value), average=average,
-                                   name=name))
+                                   name=name, op=op,
+                                   process_set=process_set))
 
 
-def allgather(value, name: Optional[str] = None):
-    return np.asarray(_C.allgather(np.asarray(value), name=name))
+def allgather(value, name: Optional[str] = None, process_set=None):
+    return np.asarray(_C.allgather(np.asarray(value), name=name,
+                                   process_set=process_set))
 
 
-def broadcast(value, root_rank: int, name: Optional[str] = None):
+def broadcast(value, root_rank: int, name: Optional[str] = None,
+              process_set=None):
     return np.asarray(_C.broadcast(np.asarray(value), root_rank,
-                                   name=name))
+                                   name=name, process_set=process_set))
 
 
 # ---------------------------------------------------------------------------

@@ -199,3 +199,57 @@ def test_retry_budget_resets_after_committed_progress(hvd, monkeypatch):
     with pytest.raises(HorovodError):
         never(s2)
     assert len(tries) == 2  # initial + 1 retry
+
+
+def test_trainer_state_commit_restore_and_retry(hvd):
+    """TrainerState binds elastic commit/rollback to a live Trainer
+    (≙ the reference-lineage framework State classes): a transient
+    failure mid-fit rolls the trainer's params/opt_state back to the
+    last commit and the retried run completes."""
+    import optax
+
+    from horovod_tpu.frontends.loop import Trainer
+    from horovod_tpu.models.mnist import (MnistMLP, cross_entropy_loss,
+                                          init_params, synthetic_mnist)
+
+    model = MnistMLP(hidden=16)
+
+    def loss_fn(p, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": p}, images),
+                                  labels)
+
+    trainer = Trainer(loss_fn, init_params(model), optax.sgd, lr=0.1)
+    images, labels = synthetic_mnist(64)
+    batches = lambda e, s: (jnp.asarray(images), jnp.asarray(labels))
+
+    state = elastic.TrainerState(trainer, epoch=0)
+    failed = []
+
+    @elastic.run
+    def train(state):
+        while state.epoch < 3:
+            trainer.fit(batches, epochs=state.epoch + 1,
+                        steps_per_epoch=2, initial_epoch=state.epoch)
+            state.epoch += 1
+            state.commit()
+            if state.epoch == 2 and not failed:
+                # Diverge the live trainer PAST the commit, then fail:
+                # the rollback must restore the committed params.
+                trainer.params = jax.tree_util.tree_map(
+                    lambda x: x * 0.0, trainer.params)
+                failed.append(True)
+                raise HorovodError("transient")
+        return trainer.history
+
+    import jax
+
+    history = train(state)
+    assert state.epoch == 3
+    assert failed == [True]
+    # The zeroed params were rolled back: training continued and the
+    # final loss is finite and improved from epoch 0.
+    assert history[-1]["loss"] < history[0]["loss"]
+    # Committed snapshot round-trips through the trainer property.
+    w = np.asarray(jax.tree_util.tree_leaves(trainer.params)[0])
+    assert np.isfinite(w).all() and (w != 0).any()
