@@ -36,6 +36,8 @@ from .core.state import (  # noqa: F401
     replica_id,
     shutdown,
     size,
+    start_timeline,
+    stop_timeline,
 )
 from .ops.collective import (  # noqa: F401
     Adasum,

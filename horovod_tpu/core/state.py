@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -422,6 +423,46 @@ def process_index() -> int:
 def process_count() -> int:
     _check_initialized()
     return _state.process_count
+
+
+def start_timeline(file_path: str) -> None:
+    """Begin (or switch) Chrome-trace timeline recording at runtime
+    (≙ the post-v0.13 ``hvd.start_timeline``; the v0.13 reference could
+    only enable it via ``HOROVOD_TIMELINE`` at init).  Rank-0-only like
+    the env path — other ranks no-op."""
+    _check_initialized()
+    if _state.process_index != 0:
+        return
+    from ..utils.timeline import Timeline
+
+    old, _state.timeline = _state.timeline, None
+    if old is not None:
+        time.sleep(0.02)  # let in-flight drain-tick events finish
+        old.close()
+    tl = Timeline(file_path)
+    with _state.lock:
+        _state.timeline = tl
+        if _state.coordinator is not None:
+            _state.coordinator.timeline = tl
+        for ps in _state.process_sets.values():
+            if ps.coordinator is not None:
+                ps.coordinator.timeline = tl
+
+
+def stop_timeline() -> None:
+    """Stop timeline recording and flush the file (≙ the post-v0.13
+    ``hvd.stop_timeline``)."""
+    _check_initialized()
+    with _state.lock:
+        tl, _state.timeline = _state.timeline, None
+        if _state.coordinator is not None:
+            _state.coordinator.timeline = None
+        for ps in _state.process_sets.values():
+            if ps.coordinator is not None:
+                ps.coordinator.timeline = None
+    if tl is not None:
+        time.sleep(0.02)  # let in-flight drain-tick events finish
+        tl.close()
 
 
 def mpi_threads_supported() -> bool:

@@ -53,7 +53,8 @@ from ..core.features import (  # noqa: F401  (feature-query shims)
     cuda_built, gloo_built, mpi_built, mpi_enabled, nccl_built, rocm_built)
 from ..core.state import (cross_rank, cross_size, init,  # noqa: F401
                           is_initialized, local_rank, local_size,
-                          mpi_threads_supported, rank, shutdown, size)
+                          mpi_threads_supported, rank, shutdown, size,
+                          start_timeline, stop_timeline)
 from ..ops import collective as _C
 from ..ops import sparse as _S
 from ..ops.collective import (  # noqa: F401  (post-v0.13 API surface)

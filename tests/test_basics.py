@@ -45,3 +45,36 @@ def test_mesh_axis(hvd):
     m = hvd.mesh()
     assert m.axis_names == (hvd.REPLICA_AXIS,)
     assert m.devices.size == hvd.size()
+
+
+def test_start_stop_timeline_at_runtime(hvd, tmp_path):
+    """Runtime timeline control (post-v0.13 hvd.start_timeline /
+    stop_timeline; the reference only had the init-time env var): start
+    mid-job, capture negotiation + execution events, stop (valid JSON),
+    then start a SECOND file — switching works."""
+    import json
+
+    import jax.numpy as jnp
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    hvd.start_timeline(str(a))
+    hvd.allreduce(jnp.ones((4,)), name="tl.op1", average=False)
+    hvd.start_timeline(str(b))  # switch: closes a, records to b
+    hvd.allgather(jnp.ones((2, 2)), name="tl.op2")
+    hvd.stop_timeline()
+    hvd.allreduce(jnp.ones((4,)), name="tl.op3", average=False)  # untraced
+
+    def events(path):
+        text = path.read_text()
+        arr = json.loads(text if text.rstrip().endswith("]")
+                         else text.rstrip().rstrip(",") + "]")
+        return {e.get("name") for e in arr if isinstance(e, dict)}
+
+    names_a = events(a)
+    assert any("NEGOTIATE" in (n or "") for n in names_a)
+    names_b = events(b)
+    assert any("ALLGATHER" in (n or "") for n in names_b), names_b
+    # op3 ran untraced: its name appears in neither file's process rows.
+    all_rows = names_a | names_b
+    assert "tl.op3" not in all_rows
