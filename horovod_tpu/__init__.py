@@ -63,10 +63,12 @@ from .ops.collective import (  # noqa: F401
     grouped_allreduce_async,
     grouped_reducescatter,
     grouped_reducescatter_async,
+    global_process_set,
     join,
     poll,
     reducescatter,
     reducescatter_async,
+    remove_process_set,
     shard,
     synchronize,
 )

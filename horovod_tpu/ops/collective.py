@@ -1288,6 +1288,13 @@ def _enqueue(x, op: RequestType, name: Optional[str],
     st = _state.global_state()
     if st.peer_shutdown:
         raise HorovodError(SHUT_DOWN_ERROR_MESSAGE)
+    if process_set is not None and process_set.process_set_id == 0:
+        process_set = None  # hvd.global_process_set() ≡ the world
+    if process_set is not None and \
+            process_set.process_set_id not in st.process_sets:
+        raise HorovodError(
+            f"process set {process_set.process_set_id} is not registered "
+            f"(was it removed, or created before a re-init?).")
     if process_set is not None and not process_set.included():
         raise HorovodError(
             f"rank {st.process_index} is not a member of process set "
@@ -1394,6 +1401,44 @@ def allgather_async(tensor, name: Optional[str] = None,
                     process_set=None) -> int:
     return _enqueue(tensor, RequestType.ALLGATHER, name, prefix="allgather",
                     process_set=process_set)
+
+
+def remove_process_set(process_set) -> bool:
+    """Deregister a process set (≙ the post-v0.13
+    ``hvd.remove_process_set``).  Collective in multi-process mode (every
+    process must call it for the same set, like registration); returns
+    False when the set was already removed.  The global set cannot be
+    removed."""
+    _state._check_initialized()
+    st = _state.global_state()
+    psid = process_set.process_set_id
+    if psid == 0:
+        raise ValueError("the global process set cannot be removed")
+    if psid not in st.process_sets:
+        return False
+    if st.multiprocess:
+        from .objects import allgather_object
+
+        regs = allgather_object(psid, name=f"process_set.remove.{psid}")
+        if any(r != psid for r in regs):
+            raise HorovodError(
+                f"remove_process_set must be called by every process for "
+                f"the same set; this process removed {psid} but the job "
+                f"removed {regs}.")
+    ps = st.process_sets.pop(psid)
+    ps.close()
+    return True
+
+
+def global_process_set():
+    """The implicit world communicator as a :class:`ProcessSet`
+    (≙ ``hvd.global_process_set``; a function here because the world is
+    only known after ``init()``).  Passing it (or ``None``) to a
+    collective's ``process_set=`` is equivalent."""
+    from .process_set import ProcessSet
+
+    _state._check_initialized()
+    return ProcessSet(0, tuple(range(_state.contributor_count())))
 
 
 def alltoall_async(tensor, splits=None, name: Optional[str] = None,

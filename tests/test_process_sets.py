@@ -149,3 +149,20 @@ def test_set_fusion_sizes_fall_back_to_shapes(make_coord=None):
     assert all(r.process_set_id == 3 for r in resps)
     groups = sorted(sorted(r.tensor_names) for r in resps)
     assert groups == [["a", "c"], ["b"]], groups
+
+
+def test_remove_process_set_and_global_set(hvd):
+    """remove_process_set deregisters (post-v0.13 API); the global set
+    object is equivalent to process_set=None and cannot be removed."""
+    ps = hvd.add_process_set([0, 1])
+    assert hvd.remove_process_set(ps) is True
+    assert hvd.remove_process_set(ps) is False  # already gone
+    with pytest.raises(hvd.HorovodError, match="not registered"):
+        hvd.allreduce(jnp.ones((1,)), process_set=ps, name="gone.set")
+
+    g = hvd.global_process_set()
+    assert g.process_set_id == 0 and g.size() == hvd.size()
+    out = hvd.allreduce(jnp.array([1.0]), average=False, process_set=g)
+    assert float(out[0]) == float(hvd.size())
+    with pytest.raises(ValueError, match="cannot be removed"):
+        hvd.remove_process_set(g)
