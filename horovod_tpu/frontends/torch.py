@@ -61,6 +61,7 @@ from ..ops.compression import Compression  # noqa: F401  (hvd.Compression)
 from ..ops.process_set import ProcessSet  # noqa: F401
 from ..ops.objects import (allgather_object,  # noqa: F401  (object API)
                            broadcast_object)
+from .torch_sync_bn import SyncBatchNorm  # noqa: F401  (hvd.SyncBatchNorm)
 
 # handle -> pending-op record.  Strong references (the target may be a
 # temporary view object like ``p.data`` whose storage we must mutate);

@@ -1,0 +1,117 @@
+"""SyncBatchNorm for the torch frontend (≙ the post-v0.13
+``hvd.SyncBatchNorm``): BatchNorm whose statistics span every rank's
+batch shard, not just the local one.
+
+Redesign vs the reference lineage: Horovod's implementation leans on
+``torch.batch_norm_gather_stats_with_counts`` (a CUDA kernel family);
+here both passes compute the global moments with plain allreduces over
+the eager wire — a grouped allreduce of (sum, sum-of-squares, count) in
+forward, and of the two gradient sums in backward — so the module works
+on CPU tensors and rides the same negotiation/validation/timeline path
+as every other collective.
+
+The math: with global mean/var over n = Σ n_r rows,
+``dx = (w/σ) (g − mean_n(g) − x̂ · mean_n(g·x̂))`` where both means are
+GLOBAL (they normalize the population the statistics came from);
+``dw = Σ_local(g·x̂)`` and ``db = Σ_local(g)`` stay local — the
+DistributedOptimizer averages parameter gradients afterwards, exactly
+like every other layer.
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn.functional as F
+
+from ..core import state as _state
+from ..ops import collective as _C
+
+
+def _global_sums(tensors, name: str):
+    """Grouped allreduce (sum) of same-shape-per-rank vectors; returns
+    torch tensors.  One wire collective via Tensor Fusion."""
+    outs = _C.grouped_allreduce(
+        [t.detach().numpy() for t in tensors], average=False, name=name)
+    import numpy as np
+
+    return [torch.from_numpy(np.ascontiguousarray(np.asarray(o)))
+            for o in outs]
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, x, weight, bias, running_mean, running_var,
+                eps, momentum, name):
+        dims = [0] + list(range(2, x.dim()))
+        n_local = float(x.numel() // x.shape[1])
+        local_sum = x.sum(dim=dims)
+        local_sumsq = (x * x).sum(dim=dims)
+        count = torch.tensor([n_local], dtype=x.dtype)
+        g_sum, g_sumsq, g_count = _global_sums(
+            [local_sum, local_sumsq, count], name=f"{name}.fwd")
+        n = float(g_count[0])
+        mean = g_sum / n
+        var = g_sumsq / n - mean * mean
+        var = torch.clamp(var, min=0.0)
+        std = torch.sqrt(var + eps)
+        shape = [1, -1] + [1] * (x.dim() - 2)
+        xhat = (x - mean.reshape(shape)) / std.reshape(shape)
+        out = xhat * weight.reshape(shape) + bias.reshape(shape)
+        if running_mean is not None:
+            with torch.no_grad():
+                unbiased = var * (n / max(n - 1.0, 1.0))
+                running_mean.mul_(1 - momentum).add_(momentum * mean)
+                running_var.mul_(1 - momentum).add_(momentum * unbiased)
+        ctx.save_for_backward(xhat, weight, std)
+        ctx.n_global = n
+        ctx.name = name
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        xhat, weight, std = ctx.saved_tensors
+        dims = [0] + list(range(2, grad_out.dim()))
+        shape = [1, -1] + [1] * (grad_out.dim() - 2)
+        local_g = grad_out.sum(dim=dims)
+        local_gx = (grad_out * xhat).sum(dim=dims)
+        g_g, g_gx = _global_sums([local_g, local_gx],
+                                 name=f"{ctx.name}.bwd")
+        n = ctx.n_global
+        dx = (weight.reshape(shape) / std.reshape(shape)) * (
+            grad_out - (g_g / n).reshape(shape)
+            - xhat * (g_gx / n).reshape(shape))
+        # Parameter grads stay LOCAL sums: DistributedOptimizer averages
+        # them with every other parameter gradient.
+        return (dx, local_gx, local_g, None, None, None, None, None)
+
+
+class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
+    """Drop-in BatchNorm1d/2d/3d whose training-time statistics span all
+    ranks (≙ ``hvd.SyncBatchNorm``).  Eval mode (and single-contributor
+    jobs) falls back to the stock batch_norm on running statistics."""
+
+    _instances = 0
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        SyncBatchNorm._instances += 1
+        self._hvd_name = f"sync_bn.{SyncBatchNorm._instances}"
+
+    def _check_input_dim(self, input) -> None:
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D)")
+
+    def forward(self, x):
+        self._check_input_dim(x)
+        _state._check_initialized()
+        if not self.training or _state.contributor_count() == 1:
+            return F.batch_norm(
+                x, self.running_mean, self.running_var, self.weight,
+                self.bias, self.training, self.momentum, self.eps)
+        if self.num_batches_tracked is not None:
+            with torch.no_grad():
+                self.num_batches_tracked += 1
+        return _SyncBatchNormFn.apply(
+            x, self.weight, self.bias, self.running_mean,
+            self.running_var, self.eps, self.momentum, self._hvd_name)

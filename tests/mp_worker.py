@@ -262,6 +262,34 @@ def scenario_torch_frontend(hvd):
     assert o2.param_groups[0]["lr"] == 0.5, o2.param_groups[0]["lr"]
     assert any("momentum_buffer" in st
                for st in o2.state_dict()["state"].values())
+
+    # SyncBatchNorm across REAL processes: each rank normalizes ITS half
+    # of a batch with statistics spanning BOTH halves — output, input
+    # gradients, and running stats must match stock BatchNorm1d applied
+    # to the full batch (the defining property; per-rank BN would use
+    # divergent means).
+    g = torch.Generator().manual_seed(7)
+    full = torch.randn(8, 3, generator=g) * 2.0 + 1.0
+    gout = torch.randn(8, 3, generator=g)
+    half = full[rank * 4:(rank + 1) * 4].clone().requires_grad_(True)
+    sbn = thvd.SyncBatchNorm(3, momentum=0.4)
+    out = sbn(half)
+    out.backward(gout[rank * 4:(rank + 1) * 4])
+
+    ref_in = full.clone().requires_grad_(True)
+    ref = torch.nn.BatchNorm1d(3, momentum=0.4)
+    ref_out = ref(ref_in)
+    ref_out.backward(gout)
+    np.testing.assert_allclose(
+        out.detach().numpy(),
+        ref_out.detach().numpy()[rank * 4:(rank + 1) * 4], atol=1e-5)
+    np.testing.assert_allclose(
+        half.grad.numpy(),
+        ref_in.grad.numpy()[rank * 4:(rank + 1) * 4], atol=1e-5)
+    np.testing.assert_allclose(sbn.running_mean.numpy(),
+                               ref.running_mean.numpy(), atol=1e-5)
+    np.testing.assert_allclose(sbn.running_var.numpy(),
+                               ref.running_var.numpy(), atol=1e-4)
     print(f"TORCH_OK rank={rank}")
 
 

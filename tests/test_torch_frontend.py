@@ -288,3 +288,46 @@ def test_feature_query_shims(hvd):
     assert H.xla_built()
     assert isinstance(H.native_built(), bool)
     assert thvd.mpi_built() is False  # same shims on the frontends
+
+
+def test_sync_batch_norm_matches_local_bn_single_process(hvd):
+    """Single-process, the global statistics reduce to the local ones
+    (every replica contributes the identical batch), so SyncBatchNorm
+    must match stock BatchNorm1d exactly — forward, backward, and
+    running statistics."""
+    import horovod_tpu.frontends.torch as thvd
+
+    torch.manual_seed(0)
+    x = torch.randn(16, 4, requires_grad=True)
+    x2 = x.detach().clone().requires_grad_(True)
+
+    sbn = thvd.SyncBatchNorm(4, momentum=0.3)
+    bn = torch.nn.BatchNorm1d(4, momentum=0.3)
+    bn.load_state_dict({k: v.clone() for k, v in sbn.state_dict().items()})
+
+    out_s = sbn(x)
+    out_r = bn(x2)
+    np.testing.assert_allclose(out_s.detach().numpy(),
+                               out_r.detach().numpy(), atol=1e-5)
+    g = torch.randn_like(out_s)
+    out_s.backward(g)
+    out_r.backward(g)
+    np.testing.assert_allclose(x.grad.numpy(), x2.grad.numpy(), atol=1e-5)
+    np.testing.assert_allclose(sbn.weight.grad.numpy(),
+                               bn.weight.grad.numpy(), atol=1e-4)
+    np.testing.assert_allclose(sbn.bias.grad.numpy(),
+                               bn.bias.grad.numpy(), atol=1e-4)
+    np.testing.assert_allclose(sbn.running_mean.numpy(),
+                               bn.running_mean.numpy(), atol=1e-5)
+    # The unbiased-variance correction uses the GLOBAL row count
+    # (n = replicas x local rows — correct for real sharded batches);
+    # stock BN uses the local 16.  Rescale to compare.
+    n_local, n_glob = 16.0, 16.0 * hvd.size()
+    scale = (n_glob / (n_glob - 1)) / (n_local / (n_local - 1))
+    base = 1.0 - 0.3  # init running_var=1, one update at momentum 0.3
+    want = (bn.running_var.numpy() - base) * scale + base
+    np.testing.assert_allclose(sbn.running_var.numpy(), want, atol=1e-5)
+    # Eval mode uses the running statistics (stock path).
+    sbn.eval()
+    out_eval = sbn(x.detach())
+    assert torch.isfinite(out_eval).all()
