@@ -141,3 +141,23 @@ def test_elastic_train_example(tmp_path):
     assert "resumed rank=0 from committed step 4" in out
     assert "elastic_train: OK rank=0" in out
     assert "elastic_train: OK rank=1" in out
+
+
+@pytest.mark.slow
+def test_collectives_tour_example():
+    """Every collective family self-verified over the real 2-process
+    launcher in one run."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--platform", "cpu",
+         os.path.join(REPO, "examples", "collectives_tour.py")],
+        env=env, cwd=REPO, capture_output=True, timeout=300)
+    out = proc.stdout.decode() + proc.stderr.decode()
+    assert proc.returncode == 0, out
+    assert "collectives_tour: OK rank=0" in out
+    assert "collectives_tour: OK rank=1" in out
