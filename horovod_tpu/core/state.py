@@ -249,6 +249,14 @@ def init(devices=None) -> None:
                 _state.tick_seconds = cycle
                 if _state.coordinator is not None:
                     _state.coordinator.set_fusion_threshold(threshold)
+                # Per-process-set coordinators fuse independently; push
+                # the committed threshold to them too, else set
+                # collectives keep the construction-time value.  Snapshot:
+                # this runs on the drain tick thread while a user thread
+                # may be registering/removing sets.
+                for ps in list(_state.process_sets.values()):
+                    if ps.coordinator is not None:
+                        ps.coordinator.set_fusion_threshold(threshold)
 
             _state.autotuner = Autotuner(_apply_tuning)
         else:
@@ -321,6 +329,11 @@ def shutdown() -> None:
         for ps in _state.process_sets.values():
             ps.close()
         _state.process_sets = {}
+        # Kernel caches (_kernels/_subset_kernels/_mp_mesh_and_kernels)
+        # survive shutdown on purpose: they are keyed on jax Device
+        # OBJECTS, so same-backend re-inits (every test) share one XLA
+        # compilation while a restarted backend's fresh Device objects
+        # miss naturally instead of resurrecting a stale mesh.
         if _state.timeline is not None:
             _state.timeline.close()
             _state.timeline = None
@@ -433,12 +446,22 @@ def start_timeline(file_path: str) -> None:
     _check_initialized()
     if _state.process_index != 0:
         return
+    from ..ops.collective import _drain_lock
     from ..utils.timeline import Timeline
 
-    old, _state.timeline = _state.timeline, None
+    with _state.lock:
+        old, _state.timeline = _state.timeline, None
+        if _state.coordinator is not None:
+            _state.coordinator.timeline = None
+        for ps in _state.process_sets.values():
+            if ps.coordinator is not None:
+                ps.coordinator.timeline = None
     if old is not None:
-        time.sleep(0.02)  # let in-flight drain-tick events finish
-        old.close()
+        # The tick period is runtime-adjustable (HOROVOD_CYCLE_TIME /
+        # autotune), so a fixed sleep cannot bound an in-flight drain
+        # tick — serialize with the drain loop instead.
+        with _drain_lock:
+            old.close()
     tl = Timeline(file_path)
     with _state.lock:
         _state.timeline = tl
@@ -453,6 +476,8 @@ def stop_timeline() -> None:
     """Stop timeline recording and flush the file (≙ the post-v0.13
     ``hvd.stop_timeline``)."""
     _check_initialized()
+    from ..ops.collective import _drain_lock
+
     with _state.lock:
         tl, _state.timeline = _state.timeline, None
         if _state.coordinator is not None:
@@ -461,8 +486,8 @@ def stop_timeline() -> None:
             if ps.coordinator is not None:
                 ps.coordinator.timeline = None
     if tl is not None:
-        time.sleep(0.02)  # let in-flight drain-tick events finish
-        tl.close()
+        with _drain_lock:  # serialize with an in-flight drain tick
+            tl.close()
 
 
 def mpi_threads_supported() -> bool:

@@ -44,9 +44,18 @@ class _SyncBatchNormFn(torch.autograd.Function):
                 eps, momentum, name):
         dims = [0] + list(range(2, x.dim()))
         n_local = float(x.numel() // x.shape[1])
-        local_sum = x.sum(dim=dims)
-        local_sumsq = (x * x).sum(dim=dims)
-        count = torch.tensor([n_local], dtype=x.dtype)
+        # Compute the moments with a float32 floor: fp16 sum-of-squares
+        # overflows past ~65504, the fp16 count loses integer precision
+        # above 2048, and even the fp16 *product* x·x carries a rounding
+        # bias that skews the variance (upstream's gather_stats kernels
+        # accumulate in float for the same reason).  float64 inputs keep
+        # f64 through the LOCAL accumulation; the allreduce wire itself
+        # reduces in float32 unless jax x64 mode is enabled.
+        acc = torch.float64 if x.dtype == torch.float64 else torch.float32
+        xf = x.to(acc)
+        local_sum = xf.sum(dim=dims)
+        local_sumsq = (xf * xf).sum(dim=dims)
+        count = torch.tensor([n_local], dtype=acc)
         g_sum, g_sumsq, g_count = _global_sums(
             [local_sum, local_sumsq, count], name=f"{name}.fwd")
         n = float(g_count[0])
@@ -55,13 +64,16 @@ class _SyncBatchNormFn(torch.autograd.Function):
         var = torch.clamp(var, min=0.0)
         std = torch.sqrt(var + eps)
         shape = [1, -1] + [1] * (x.dim() - 2)
-        xhat = (x - mean.reshape(shape)) / std.reshape(shape)
+        xhat = ((x - mean.to(x.dtype).reshape(shape))
+                / std.to(x.dtype).reshape(shape))
         out = xhat * weight.reshape(shape) + bias.reshape(shape)
         if running_mean is not None:
             with torch.no_grad():
                 unbiased = var * (n / max(n - 1.0, 1.0))
-                running_mean.mul_(1 - momentum).add_(momentum * mean)
-                running_var.mul_(1 - momentum).add_(momentum * unbiased)
+                running_mean.mul_(1 - momentum).add_(
+                    momentum * mean.to(running_mean.dtype))
+                running_var.mul_(1 - momentum).add_(
+                    momentum * unbiased.to(running_var.dtype))
         ctx.save_for_backward(xhat, weight, std)
         ctx.n_global = n
         ctx.name = name
@@ -72,17 +84,22 @@ class _SyncBatchNormFn(torch.autograd.Function):
         xhat, weight, std = ctx.saved_tensors
         dims = [0] + list(range(2, grad_out.dim()))
         shape = [1, -1] + [1] * (grad_out.dim() - 2)
-        local_g = grad_out.sum(dim=dims)
-        local_gx = (grad_out * xhat).sum(dim=dims)
+        acc = (torch.float64 if grad_out.dtype == torch.float64
+               else torch.float32)
+        gf = grad_out.to(acc)
+        local_g = gf.sum(dim=dims)
+        local_gx = (gf * xhat.to(acc)).sum(dim=dims)
         g_g, g_gx = _global_sums([local_g, local_gx],
                                  name=f"{ctx.name}.bwd")
         n = ctx.n_global
-        dx = (weight.reshape(shape) / std.reshape(shape)) * (
-            grad_out - (g_g / n).reshape(shape)
-            - xhat * (g_gx / n).reshape(shape))
+        dx = ((weight.to(acc).reshape(shape) / std.to(acc).reshape(shape)) * (
+            gf - (g_g / n).reshape(shape)
+            - xhat.to(acc) * (g_gx / n).reshape(shape))
+        ).to(grad_out.dtype)
         # Parameter grads stay LOCAL sums: DistributedOptimizer averages
         # them with every other parameter gradient.
-        return (dx, local_gx, local_g, None, None, None, None, None)
+        return (dx, local_gx.to(weight.dtype), local_g.to(weight.dtype),
+                None, None, None, None, None)
 
 
 class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
@@ -105,13 +122,21 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
     def forward(self, x):
         self._check_input_dim(x)
         _state._check_initialized()
+        if self.training and self.num_batches_tracked is not None:
+            with torch.no_grad():
+                self.num_batches_tracked += 1
+        # momentum=None means a cumulative moving average (stock
+        # _BatchNorm semantics: factor = 1/num_batches_tracked).
+        if self.momentum is not None:
+            factor = self.momentum
+        elif self.training and self.num_batches_tracked is not None:
+            factor = 1.0 / float(self.num_batches_tracked)
+        else:
+            factor = 0.0
         if not self.training or _state.contributor_count() == 1:
             return F.batch_norm(
                 x, self.running_mean, self.running_var, self.weight,
-                self.bias, self.training, self.momentum, self.eps)
-        if self.num_batches_tracked is not None:
-            with torch.no_grad():
-                self.num_batches_tracked += 1
+                self.bias, self.training, factor, self.eps)
         return _SyncBatchNormFn.apply(
             x, self.weight, self.bias, self.running_mean,
-            self.running_var, self.eps, self.momentum, self._hvd_name)
+            self.running_var, self.eps, factor, self._hvd_name)

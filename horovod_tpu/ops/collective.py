@@ -477,15 +477,18 @@ def _build_kernels(mesh):
 
 @functools.lru_cache(maxsize=None)
 def _kernels(mesh_key):
-    """Kernels over the replica mesh; ``mesh_key`` (the device-id tuple)
-    rebuilds them when the replica set changes (tests re-init with device
-    subsets)."""
+    """Kernels over the replica mesh; ``mesh_key`` (the tuple of Device
+    OBJECTS, not ids) rebuilds them when the replica set changes (tests
+    re-init with device subsets) AND when the backend itself restarts —
+    a fresh backend mints fresh Device objects that never compare equal
+    to the dead ones, so a stale mesh can't be handed back, while
+    same-backend re-inits (every test) keep sharing one compilation."""
     return _build_kernels(_state.global_state().mesh)
 
 
 def _mesh_kernels():
     st = _state.global_state()
-    return _kernels(tuple(d.id for d in st.devices))
+    return _kernels(tuple(st.devices))
 
 
 @functools.lru_cache(maxsize=None)
@@ -508,6 +511,8 @@ def _subset_kernels(devs: tuple):
 
 @functools.lru_cache(maxsize=None)
 def _mp_mesh_and_kernels(mesh_key):
+    # mesh_key is the tuple of local Device objects (see _kernels on why
+    # object identity, not ids).
     by_proc: Dict[int, Any] = {}
     for d in jax.devices():
         if d.process_index not in by_proc or d.id < by_proc[d.process_index].id:
@@ -519,7 +524,7 @@ def _mp_mesh_and_kernels(mesh_key):
 
 def _mp_kernels():
     st = _state.global_state()
-    return _mp_mesh_and_kernels(tuple(d.id for d in st.devices))
+    return _mp_mesh_and_kernels(tuple(st.devices))
 
 
 def _mp_global(x: jax.Array, ps=None):

@@ -102,8 +102,15 @@ def _adasum_gradients(grads):
         raise ValueError(
             f"op=Adasum requires a power-of-two replica count for its "
             f"recursive-doubling ppermute ladder; got {n}.")
+    # Accumulation dtype: promote over the leaf dtypes with a float32
+    # floor, matching the eager _adasum_ladder's promote_types rule.
+    # (Without jax x64 mode this always resolves to float32; the loop
+    # keeps the two Adasum paths' precision contract identical.)
+    acc_dtype = jnp.float32
+    for g in leaves:
+        acc_dtype = jnp.promote_types(acc_dtype, g.dtype)
     v = jnp.concatenate(
-        [jnp.ravel(g).astype(jnp.float32) for g in leaves])
+        [jnp.ravel(g).astype(acc_dtype) for g in leaves])
     for r in range(int(math.log2(n))):
         dist = 1 << r
         perm = [(i, i ^ dist) for i in range(n)]

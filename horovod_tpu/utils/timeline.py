@@ -89,14 +89,19 @@ class Timeline:
 
     def _event(self, ph: str, tensor: str, name: str = "",
                args: Optional[dict] = None) -> None:
-        if self._native is not None:
-            _native.raw().hvd_timeline_event(
-                self._native,
-                {"B": 0, "E": 1, "i": 2, "M": 3}[ph],
-                tensor.encode(), name.encode(),
-                json.dumps(args or {}).encode(), 0.0)
-            return
+        # The whole event path holds the lock: writers run on the drain
+        # tick thread AND user threads (sync eager submits), while rank 0
+        # may concurrently stop_timeline() — the native handle must not
+        # be freed under a writer, and a post-close event must be a
+        # silent no-op, not a use-after-free.
         with self._lock:
+            if self._native is not None:
+                _native.raw().hvd_timeline_event(
+                    self._native,
+                    {"B": 0, "E": 1, "i": 2, "M": 3}[ph],
+                    tensor.encode(), name.encode(),
+                    json.dumps(args or {}).encode(), 0.0)
+                return
             ev = {"ph": ph, "ts": self._ts_us(),
                   "pid": self._pid_locked(tensor)}
             if name:
@@ -136,11 +141,11 @@ class Timeline:
         self._event(_PH_END, tensor, args=args or None)
 
     def close(self) -> None:
-        if self._native is not None:
-            _native.raw().hvd_timeline_close(self._native)
-            self._native = None
-            return
         with self._lock:
+            if self._native is not None:
+                _native.raw().hvd_timeline_close(self._native)
+                self._native = None
+                return
             if self._file is not None:
                 # Chrome tracing tolerates a trailing comma / missing "]",
                 # but emit a valid JSON array anyway.

@@ -331,3 +331,51 @@ def test_sync_batch_norm_matches_local_bn_single_process(hvd):
     sbn.eval()
     out_eval = sbn(x.detach())
     assert torch.isfinite(out_eval).all()
+
+
+def test_sync_batch_norm_fp16_stats_do_not_overflow(hvd):
+    """fp16 inputs: the moments must accumulate in float32 — a straight
+    fp16 sum-of-squares overflows past ~65504 (here Σx² ≈ 1.6e6 per
+    channel) and the fp16 count loses integer precision above 2048."""
+    import horovod_tpu.frontends.torch as thvd
+
+    torch.manual_seed(1)
+    base = 20.0 + 0.5 * torch.randn(4096, 4)
+    x = base.half().requires_grad_(True)
+
+    sbn = thvd.SyncBatchNorm(4).half()
+    out = sbn(x)
+    assert out.dtype == torch.float16
+    assert torch.isfinite(out).all()
+    # float32 reference over the same (fp16-quantized) inputs; residual
+    # error is the fp16 normalization arithmetic itself.
+    ref = torch.nn.BatchNorm1d(4)(x.detach().float())
+    np.testing.assert_allclose(out.detach().float().numpy(),
+                               ref.detach().numpy(), atol=5e-2)
+    out.backward(torch.ones_like(out))
+    assert torch.isfinite(x.grad).all()
+    assert torch.isfinite(sbn.weight.grad).all()
+    assert sbn.weight.grad.dtype == torch.float16
+    assert torch.isfinite(sbn.running_var).all()
+    np.testing.assert_allclose(sbn.running_mean.float().numpy(),
+                               0.1 * base.mean(0).numpy(), atol=2e-2)
+
+
+def test_sync_batch_norm_momentum_none_cumulative(hvd):
+    """momentum=None is stock _BatchNorm's cumulative-moving-average mode
+    (factor = 1/num_batches_tracked); it must not crash and the first
+    update must overwrite the init stats entirely (factor 1.0)."""
+    import horovod_tpu.frontends.torch as thvd
+
+    torch.manual_seed(2)
+    x = torch.randn(64, 3) * 2.0 + 5.0
+    sbn = thvd.SyncBatchNorm(3, momentum=None)
+    sbn(x)
+    assert int(sbn.num_batches_tracked) == 1
+    np.testing.assert_allclose(sbn.running_mean.numpy(),
+                               x.mean(0).numpy(), atol=1e-4)
+    sbn(x)  # second update: factor 1/2, stats stay at the batch moments
+    np.testing.assert_allclose(sbn.running_mean.numpy(),
+                               x.mean(0).numpy(), atol=1e-4)
+    sbn.eval()
+    assert torch.isfinite(sbn(x)).all()
