@@ -25,13 +25,17 @@ MFU ceiling analysis (v5e, measured 2026-07, round 3):
     BN + elementwise chains are HBM-bound — consistent with the 30-40%
     MFU commonly reported for ResNet-50 training on TPUs.
 
-Supervision (round 4): the parent enforces a TOTAL wall-clock budget
-(``HVD_TPU_BENCH_TOTAL_BUDGET``, default 1500 s) sized to fit inside the
-driver's outer timeout, so a dead TPU tunnel produces the structured
-failure JSON instead of rc=124.  Before committing minutes to a compile
-attempt it runs a ~30 s tunnel probe (tiny jitted matmul in a killable
-child); per-attempt timeouts are derived from the remaining budget.  On
-success it also runs an eager-path smoke on the real chip
+Supervision (round 4, hardened round 5): the parent enforces a TOTAL
+wall-clock budget (``HVD_TPU_BENCH_TOTAL_BUDGET``, default 1500 s) sized
+to fit inside the driver's outer timeout, so a dead TPU tunnel produces
+the structured failure JSON instead of rc=124.  The tunnel probe (tiny
+jitted matmul in a SIGKILL-able child) RETRIES with backoff for up to
+~55% of the budget — the tunnel's observed outages recover on the scale
+of minutes, and round 4 lost its number to a single 75 s probe.  Every
+probe/measurement event is recorded in ``attempt_log`` in the final
+JSON, success or failure.  Children share a persistent XLA compilation
+cache (``.jax_cache/``) so retries skip recompilation.  On success it
+also runs an eager-path smoke on the real chip
 (allreduce/allgather/broadcast + a torch-frontend in-place round trip)
 and attaches ``eager_tpu_smoke`` to the JSON.
 
@@ -313,21 +317,35 @@ def _run_child(extra_args, timeout):
 
     ``payload`` is the last parseable JSON line on stdout (a child that
     completed the measurement may still wedge at exit in the tunnel —
-    salvage its printed result).
+    salvage its printed result).  A timed-out child is SIGKILLed —
+    SIGTERM does nothing to a process wedged inside the tunnel's C
+    layer (observed: a probe child survived ``timeout 360`` by 20+
+    minutes).  Children share one persistent XLA compilation cache so
+    a retry after a flake does not pay the full compile again.
     """
     import subprocess
 
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
     cmd = [sys.executable, os.path.abspath(__file__)] + extra_args
     timed_out = False
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, env=env)
     try:
-        proc = subprocess.run(cmd, stdout=subprocess.PIPE,
-                              timeout=timeout)
-        stdout, rc = proc.stdout, proc.returncode
-    except subprocess.TimeoutExpired as e:
+        stdout, _ = proc.communicate(timeout=timeout)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
         timed_out = True
-        stdout, rc = e.stdout or b"", 0
+        proc.kill()  # SIGKILL — see docstring
+        try:
+            stdout, _ = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            stdout = b""
+        rc = 0
     payload = None
-    for ln in reversed(stdout.decode(errors="replace").splitlines()):
+    for ln in reversed((stdout or b"").decode(errors="replace")
+                       .splitlines()):
         if not ln.strip().startswith("{"):
             continue
         try:
@@ -338,7 +356,7 @@ def _run_child(extra_args, timeout):
     return rc, payload, timed_out
 
 
-def _fail_json(error: str, attempts: int) -> int:
+def _fail_json(error: str, attempts: int, attempt_log=None) -> int:
     """Persistent failure: one parseable JSON line, not a traceback."""
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
@@ -347,6 +365,7 @@ def _fail_json(error: str, attempts: int) -> int:
         "vs_baseline": None,
         "error": error,
         "attempts": attempts,
+        "attempt_log": attempt_log or [],
     }))
     return 1
 
@@ -357,6 +376,11 @@ _BUDGET_RESERVE = 15.0
 _MIN_ATTEMPT = 120.0
 _PROBE_TIMEOUT = 75.0
 _SMOKE_TIMEOUT = 150.0
+# The probe phase may spend up to this fraction of the total budget
+# retrying a down tunnel (round-4 post-mortem: one 75 s probe surrendered
+# the whole round's number to a single tunnel blip; the tunnel is known
+# to recover on the scale of minutes).
+_PROBE_BUDGET_FRACTION = 0.55
 
 
 def _supervise(args) -> int:
@@ -368,26 +392,67 @@ def _supervise(args) -> int:
     total budget that fits inside the driver's window.
     """
     deadline = time.monotonic() + args.total_budget
+    t_start = time.monotonic()
+    attempt_log = []
 
     def remaining() -> float:
         return deadline - time.monotonic()
 
-    # Phase 0 — tunnel probe.  A dead tunnel fails here in <1 min
-    # instead of eating three 10-minute compile attempts.
-    probe_t = min(_PROBE_TIMEOUT, max(10.0, remaining() - _BUDGET_RESERVE))
-    rc, probe, timed_out = _run_child(["--_probe"], probe_t)
-    # A salvaged ok payload from a timed-out child counts as a pass: the
-    # tunnel's known failure mode includes completing the work and then
-    # wedging at interpreter exit (see _run_child) — the measurement
-    # loop tolerates that, so the probe must too.
-    if not (probe and probe.get("ok")):
-        why = ("probe timed out after "
-               f"{probe_t:.0f}s (TPU tunnel down/hung?)" if timed_out
-               else f"probe failed rc={rc}: {probe}")
-        return _fail_json(f"tunnel probe failed: {why}", attempts=0)
-    print(f"tunnel probe ok: {probe.get('device_kind')}"
-          + (" (child wedged at exit)" if timed_out or rc != 0 else ""),
-          file=sys.stderr)
+    def log_event(kind: str, detail: str) -> None:
+        attempt_log.append({"t": round(time.monotonic() - t_start, 1),
+                            "event": kind, "detail": detail})
+        print(f"[bench +{attempt_log[-1]['t']:.0f}s] {kind}: {detail}",
+              file=sys.stderr)
+
+    # Phase 0 — tunnel probe LOOP.  A dead tunnel often recovers within
+    # minutes, so spend up to _PROBE_BUDGET_FRACTION of the budget
+    # re-probing with backoff instead of surrendering the round's number
+    # to one blip; a tunnel that stays dead still gets its structured
+    # failure JSON with the full probe log.
+    probe_deadline = (t_start
+                      + _PROBE_BUDGET_FRACTION * args.total_budget)
+    probe, probe_n, quick_fails = None, 0, 0
+    while True:
+        probe_n += 1
+        probe_t = min(_PROBE_TIMEOUT,
+                      max(10.0, remaining() - _BUDGET_RESERVE))
+        rc, probe, timed_out = _run_child(["--_probe"], probe_t)
+        # A salvaged ok payload from a timed-out child counts as a pass:
+        # the tunnel's known failure mode includes completing the work
+        # and then wedging at interpreter exit (see _run_child) — the
+        # measurement loop tolerates that, so the probe must too.
+        if probe and probe.get("ok"):
+            log_event("probe_ok",
+                      f"{probe.get('device_kind')} (probe {probe_n}"
+                      + (", child wedged at exit)" if timed_out or rc
+                         else ")"))
+            break
+        why = (f"timed out after {probe_t:.0f}s" if timed_out
+               else f"rc={rc}: {probe}")
+        log_event("probe_fail", f"probe {probe_n}: {why}")
+        probe = None
+        # A probe that exits nonzero in seconds is a deterministic
+        # failure (misconfigured backend, import error) — cap its
+        # retries; only tunnel HANGS (timeouts) earn the long backoff
+        # campaign, since those are the ones observed to recover.
+        if not timed_out:
+            quick_fails += 1
+            if quick_fails >= 3:
+                break
+        backoff = min(20.0 * probe_n, 60.0)
+        # Continue only if a worst-case probe (backoff + full probe
+        # timeout) still fits before the probe deadline, so the probe
+        # phase cannot overshoot its budget share and squeeze the
+        # measurement below the total-budget guarantee.
+        if (time.monotonic() + backoff + _PROBE_TIMEOUT > probe_deadline
+                or remaining() < _MIN_ATTEMPT + _BUDGET_RESERVE):
+            break
+        time.sleep(backoff)
+    if probe is None:
+        return _fail_json(
+            f"tunnel probe failed {probe_n}x over "
+            f"{time.monotonic() - t_start:.0f}s (TPU tunnel down/hung?)",
+            attempts=0, attempt_log=attempt_log)
 
     # Phase 1 — measurement attempts, each clamped to remaining budget.
     last_err = "unknown"
@@ -411,6 +476,9 @@ def _supervise(args) -> int:
         rc, got, timed_out = _run_child(inner, attempt_t)
         if rc == 0 and got and got.get("value") is not None:
             payload = got
+            log_event("measure_ok",
+                      f"attempt {attempt + 1}: "
+                      f"{got.get('value')} img/s/chip")
             break
         if timed_out:
             last_err = (f"attempt timed out after {attempt_t:.0f}s "
@@ -418,13 +486,13 @@ def _supervise(args) -> int:
         else:
             last_err = (got or {}).get(
                 "error", f"child exited rc={rc} without a result")
-        print(f"bench attempt {attempt + 1} failed: {last_err}",
-              file=sys.stderr)
+        log_event("measure_fail", f"attempt {attempt + 1}: {last_err}")
         if attempt + 1 < args.attempts:
             time.sleep(min(10.0 * (attempt + 1),
                            max(0.0, remaining() - _MIN_ATTEMPT)))
     if payload is None:
-        return _fail_json(last_err, attempts=attempts_made)
+        return _fail_json(last_err, attempts=attempts_made,
+                          attempt_log=attempt_log)
 
     # Phase 2 — eager/dynamic-path smoke on the real chip (budget
     # permitting).  Failure is reported, not fatal: the headline number
@@ -442,6 +510,7 @@ def _supervise(args) -> int:
             payload["eager_tpu_smoke"] = f"failed rc={rc}: {smoke}"
     else:
         payload["eager_tpu_smoke"] = "skipped: budget exhausted"
+    payload["attempt_log"] = attempt_log
     print(json.dumps(payload))
     return 0
 

@@ -29,22 +29,32 @@ def test_bench_smoke_emits_contract_json():
     # Round 4: the supervisor appends an eager/dynamic-path smoke result
     # (on the driver's TPU run this is the on-chip evidence; here CPU).
     assert payload.get("eager_tpu_smoke") == "ok", payload
+    # Round 5: the attempt log rides along on success too.
+    events = [e["event"] for e in payload["attempt_log"]]
+    assert "probe_ok" in events and "measure_ok" in events, payload
 
 
 @pytest.mark.slow
 def test_bench_failure_still_emits_contract_json():
+    """A dead backend: the probe retries with backoff inside the budget
+    (round-5 hardening), then fails with the structured JSON including
+    the per-probe attempt log."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "bogus"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
-         "--attempts", "1"],
-        env=env, cwd=REPO, capture_output=True, timeout=180)
+         "--attempts", "1", "--total-budget", "300"],
+        env=env, cwd=REPO, capture_output=True, timeout=280)
     assert proc.returncode == 1
     lines = [ln for ln in proc.stdout.decode().splitlines()
              if ln.strip().startswith("{")]
     payload = json.loads(lines[-1])
     assert payload["value"] is None
     assert "error" in payload
+    # The probe must have retried (>1 probe event) before giving up.
+    probe_events = [e for e in payload["attempt_log"]
+                    if e["event"] == "probe_fail"]
+    assert len(probe_events) >= 2, payload["attempt_log"]
 
 
 @pytest.mark.slow
