@@ -36,6 +36,18 @@ the negotiation slot; the py_function boundary keeps the cross-process
 queue OUT of the compiled cluster, which is what makes this sound — the
 collective is a host callback, not a TF op XLA would try to compile.
 
+Bridge cost model (round 5, documented): each py_function node is ONE
+host round trip (graph executor → Python → eager queue → back), and TF
+auto-chains stateful nodes, so N *separate* collective calls in one
+traced step execute sequentially — N host hops, N lone negotiations, no
+fusion.  The reference's in-graph AsyncOpKernels kept enqueue on the
+runtime thread and fused via the coordinator; here the equivalent is
+BATCHING: ``DistributedGradientTape``/``DistributedOptimizer`` bridge
+the entire gradient batch through one node (one hop, one fused wire
+collective — asserted by
+tests/test_tf_frontend.py::test_tf_function_gradients_fuse_into_one_wire_collective),
+and :func:`grouped_allreduce` exposes the same batch drain directly.
+
 TPU note: TF does not drive the TPU here — JAX/XLA does.  This frontend
 exists so TF-based data/eval pipelines and models can participate in the
 same job (rank topology, collectives, validation, timeline) without a
@@ -316,8 +328,8 @@ class DistributedGradientTape:
         return tf.nest.pack_sequence_as(grads, red)
 
 
-def _allreduce_batch(tensors, average: bool, prefix: str,
-                     compression=None) -> List[Any]:
+def _allreduce_batch(tensors, average, prefix: str,
+                     compression=None, op=None) -> List[Any]:
     """Fire every allreduce async, then synchronize — so the runtime's
     tensor fusion batches the small gradients into one collective
     (ops/collective.py fused buckets) instead of N round trips.
@@ -335,7 +347,7 @@ def _allreduce_batch(tensors, average: bool, prefix: str,
 
         def _eager(*concrete):
             return _allreduce_batch(list(concrete), average, base,
-                                    compression)
+                                    compression, op=op)
 
         outs = _graph_bridge(_eager, [tensors[i] for i in idx],
                              [tensors[i].dtype for i in idx], base)
@@ -354,7 +366,7 @@ def _allreduce_batch(tensors, average: bool, prefix: str,
             continue
         wire, ctx = (a, None) if comp is None else comp.compress(a)
         handles.append(_C.allreduce_async(wire, average=average,
-                                          name=f"{prefix}.{i}"))
+                                          name=f"{prefix}.{i}", op=op))
         ctxs.append(ctx)
     return [
         None if h is None else _wrap(
@@ -362,6 +374,27 @@ def _allreduce_batch(tensors, average: bool, prefix: str,
             else comp.decompress(_C.synchronize(h), ctxs[i]), arrs[i])
         for i, h in enumerate(handles)
     ]
+
+
+def grouped_allreduce(tensors, average=None,
+                      name: Optional[str] = None, compression=None,
+                      op=None):
+    """Allreduce a list of tensors as ONE fused group (≙ the post-v0.13
+    ``hvd.grouped_allreduce``, sync variant — the async handle surface
+    stays on the torch frontend, matching the reference's split).
+
+    ``op`` takes hvd.Average/Sum/Adasum/Min/Max/Product and supersedes
+    ``average`` (averages by default).  Eager: every op is submitted
+    async before any synchronize, so Tensor Fusion packs the group into
+    ~one wire collective.  Inside ``tf.function`` the whole group
+    becomes ONE ``tf.py_function`` node — the batch drain that keeps
+    fusion alive in graph mode, and the API to reach for instead of N
+    separate :func:`allreduce` calls (which trace to N stateful nodes
+    TF executes sequentially, each paying its own host hop and
+    negotiating alone)."""
+    base = name or _C._auto_name("grouped.allreduce.tf")
+    return _allreduce_batch(list(tensors), average, base, compression,
+                            op=op)
 
 
 def DistributedOptimizer(optimizer, name: Optional[str] = None,

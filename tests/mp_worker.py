@@ -441,6 +441,32 @@ def scenario_tf_function(hvd):
     print(f"TFFN_OK rank={rank}")
 
 
+def _sync_expect_abandoned(hvd, h, who, t0: float, budget: float = 20.0):
+    """synchronize(h) with a short timeout, expecting the coordinator's
+    group-wide abandonment ERROR (not the local-fallback timeout text).
+    ``who`` pins the named withdrawing rank, or None when several ranks
+    race and the winner is nondeterministic.  The short timeout applies
+    ONLY to this call — the env is read per call, so recovery
+    collectives and co-launched scenarios keep the default."""
+    from horovod_tpu import HorovodError
+
+    prev = os.environ.get("HOROVOD_TPU_SYNC_TIMEOUT")
+    os.environ["HOROVOD_TPU_SYNC_TIMEOUT"] = "2"
+    try:
+        hvd.synchronize(h)
+        raise AssertionError("expected the withdrawal error")
+    except HorovodError as e:
+        want = ("was abandoned: rank" if who is None
+                else f"was abandoned: rank {who}")
+        assert want in str(e), str(e)
+    finally:
+        if prev is None:
+            os.environ.pop("HOROVOD_TPU_SYNC_TIMEOUT", None)
+        else:
+            os.environ["HOROVOD_TPU_SYNC_TIMEOUT"] = prev
+    assert time.monotonic() - t0 < budget, "fail-fast regressed"
+
+
 def scenario_withdraw(hvd):
     """A rank whose synchronize times out WITHDRAWS the op group-wide:
     the coordinator broadcasts an ERROR response and the op fails on
@@ -450,29 +476,7 @@ def scenario_withdraw(hvd):
     surgical: the group survives and later collectives work."""
     import jax.numpy as jnp
 
-    from horovod_tpu import HorovodError
-
     rank = hvd.rank()
-
-    def _sync_expect_abandoned(h, who: int, t0: float):
-        # The short timeout applies ONLY to the giving-up synchronize
-        # (the env is read per call), so the recovery collectives below
-        # — and any scenario sharing this launch — keep the default.
-        prev = os.environ.get("HOROVOD_TPU_SYNC_TIMEOUT")
-        os.environ["HOROVOD_TPU_SYNC_TIMEOUT"] = "2"
-        try:
-            hvd.synchronize(h)
-            raise AssertionError("expected the withdrawal error")
-        except HorovodError as e:
-            # The coordinator's message (not the local-fallback timeout
-            # text) proves the ERROR round trip happened.
-            assert f"was abandoned: rank {who}" in str(e), str(e)
-        finally:
-            if prev is None:
-                os.environ.pop("HOROVOD_TPU_SYNC_TIMEOUT", None)
-            else:
-                os.environ["HOROVOD_TPU_SYNC_TIMEOUT"] = prev
-        assert time.monotonic() - t0 < 20.0, "fail-fast regressed"
 
     # Leg 1 — a WORKER (rank 1) gives up: the WITHDRAW frame rides the
     # TCP control plane to the coordinator.
@@ -480,7 +484,7 @@ def scenario_withdraw(hvd):
     if rank == 1:
         h = hvd.allreduce_async(jnp.ones((2,)), name="abandoned.w",
                                 average=False)
-        _sync_expect_abandoned(h, 1, t0)
+        _sync_expect_abandoned(hvd, h, 1, t0)
     else:
         time.sleep(4.0)  # outlive the peer's timeout; never submit
     out = hvd.allreduce(jnp.ones((2,)), name="recover.w", average=False)
@@ -492,7 +496,7 @@ def scenario_withdraw(hvd):
     if rank == 0:
         h = hvd.allreduce_async(jnp.ones((2,)), name="abandoned.c",
                                 average=False)
-        _sync_expect_abandoned(h, 0, t1)
+        _sync_expect_abandoned(hvd, h, 0, t1)
     else:
         time.sleep(4.0)
     out = hvd.allreduce(jnp.ones((2,)), name="recover.c", average=False)
@@ -701,6 +705,144 @@ def scenario_elastic(hvd):
 
     w = train(state)
     print(f"ELASTIC_OK rank={rank} w={w.round(6).tolist()}")
+
+
+def scenario_np8(hvd):
+    """np=8 scale-out of the fusion/failure semantics (the richest
+    behaviors had only ever run at np<=3): a 24-op fusion storm, two
+    OVERLAPPING process sets with concurrent in-flight ops on both
+    coordinators, a withdraw RACE (four ranks abandon the same op
+    simultaneously), and a stall warning naming the THREE missing ranks
+    — the reference ran its whole suite under real ``mpirun -np 2``
+    (.travis.yml:96-103); this is that leg at 4x the scale."""
+    import jax.numpy as jnp
+
+    rank, size = hvd.rank(), hvd.size()
+    assert size == 8, size
+
+    # Leg 1 — fusion storm: 24 async allreduces in flight at once from
+    # every rank.  Values are per-op distinct so a fused-buffer
+    # misroute (wrong offsets) cannot cancel out.
+    hs = [hvd.allreduce_async(jnp.full((8,), float(rank + 1) * (i + 1)),
+                              average=False, name=f"storm.{i}")
+          for i in range(24)]
+    for i, h in enumerate(hs):  # sum_r (r+1)(i+1) = 36(i+1)
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                   36.0 * (i + 1))
+
+    # Leg 2 — OVERLAPPING process sets {0..4} and {3..7}: ranks 3 and 4
+    # are members of both and keep ops in flight on both per-set
+    # coordinators at once.
+    psa = hvd.add_process_set([0, 1, 2, 3, 4])
+    psb = hvd.add_process_set([3, 4, 5, 6, 7])
+    ha = hb = None
+    if psa.included():
+        ha = hvd.allreduce_async(jnp.full((2,), float(rank + 1)),
+                                 average=False, process_set=psa,
+                                 name="ov.a")
+    if psb.included():
+        hb = hvd.allreduce_async(jnp.full((2,), float(rank + 1)),
+                                 average=False, process_set=psb,
+                                 name="ov.b")
+    if ha is not None:  # ranks 0..4 contribute 1+2+3+4+5
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(ha)), 15.0)
+    if hb is not None:  # ranks 3..7 contribute 4+5+6+7+8
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(hb)), 30.0)
+    # The global set still negotiates cleanly across all 8 afterwards.
+    out = hvd.allreduce(jnp.ones((2,)), average=False, name="ov.world")
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+
+    # Leg 3 — withdraw RACE: ranks 0-3 give up on the SAME never-ready
+    # op at the same moment (four concurrent WITHDRAW frames, one of
+    # them in-process on the controller); every withdrawer gets the
+    # coordinator's group-wide abandonment error, and the group
+    # survives.
+    t0 = time.monotonic()
+    if rank < 4:
+        h = hvd.allreduce_async(jnp.ones((2,)), name="raced.op",
+                                average=False)
+        # who=None: four ranks race to withdraw; the named winner is
+        # nondeterministic.
+        _sync_expect_abandoned(hvd, h, None, t0, budget=30.0)
+    else:
+        time.sleep(5.0)  # outlive the racers' timeouts; never submit
+    out = hvd.allreduce(jnp.ones((2,)), name="race.recover",
+                        average=False)
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+
+    # Leg 4 — stall warning naming THREE late ranks: 5, 6 and 7 sit out
+    # past the threshold; the controller's stall report must list them
+    # all (the np=2 leg only ever named one).
+    threshold = float(os.environ["HOROVOD_STALL_WARNING_SECONDS"])
+    if rank < 5:
+        h = hvd.allreduce_async(jnp.ones((2,)), name="late8.op",
+                                average=False)
+        out = hvd.synchronize(h)
+    else:
+        time.sleep(3.0 * threshold)
+        out = hvd.allreduce(jnp.ones((2,)), name="late8.op",
+                            average=False)
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+    print(f"NP8_OK rank={rank}")
+
+
+def scenario_elastic2(hvd):
+    """Elastic surviving TWO sequential hard deaths: rank 1 dies at step
+    3 (incarnation 1) and again at step 7 (incarnation 2); each relaunch
+    resumes from the last commit and the final weights must match an
+    uninterrupted run, replayed in numpy in-process (both ranks' data
+    streams are deterministic functions of the rank seed, so every rank
+    can replay the whole job)."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import elastic
+
+    rank = hvd.rank()
+    edir = os.environ["HVD_TPU_ELASTIC_DIR"]
+    markers = [os.path.join(edir, "victim_died_1"),
+               os.path.join(edir, "victim_died_2")]
+    deaths = {3: markers[0], 7: markers[1]}
+    total = 10
+
+    w_true = np.array([1.0, -2.0], dtype="float32")
+    data = []
+    for r in range(2):
+        rng = np.random.RandomState(23 + r)
+        X = rng.normal(size=(total, 16, 2)).astype("float32")
+        data.append((X, X @ w_true))
+    X, y = data[rank]
+
+    state = elastic.State(w=jnp.zeros((2,)), step=0)
+
+    @elastic.run
+    def train(state):
+        if state.step > 0:
+            print(f"ELASTIC2_RESUMED rank={rank} step={state.step}")
+        while state.step < total:
+            i = state.step
+            marker = deaths.get(i)
+            if rank == 1 and marker and not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(1)  # hard failure, no handshake
+            xb, yb = jnp.asarray(X[i]), jnp.asarray(y[i])
+            grad = 2.0 * xb.T @ (xb @ state.w - yb) / xb.shape[0]
+            grad = hvd.allreduce(grad, average=True, name=f"el2.grad.{i}")
+            state.w = state.w - 0.1 * grad
+            state.step += 1
+            if state.step % 2 == 0:
+                state.commit()
+        return np.asarray(state.w)
+
+    w = train(state)
+    # In-process replay of the uninterrupted arithmetic (f32 like the
+    # training loop).
+    want = np.zeros(2, dtype="float32")
+    for i in range(total):
+        grads = [2.0 * Xr[i].T @ (Xr[i] @ want - yr[i]) / Xr[i].shape[0]
+                 for Xr, yr in data]
+        want = want - 0.1 * (grads[0] + grads[1]) / 2.0
+    np.testing.assert_allclose(w, want, atol=1e-4)
+    print(f"ELASTIC2_OK rank={rank}")
 
 
 def scenario_combo(hvd):

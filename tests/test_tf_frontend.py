@@ -171,3 +171,81 @@ def test_tf_allreduce_op_and_process_set(hvd):
         return hvdtf.allreduce(x, op=hvdtf.Max, name="tf.fn.max")
 
     np.testing.assert_allclose(f(tf.constant([5.0])).numpy(), [5.0])
+
+
+def test_tf_function_gradients_fuse_into_one_wire_collective(hvd):
+    """Tensor Fusion must survive graph mode (round-4 verdict item 4):
+    the whole DistributedGradientTape batch bridges through ONE
+    py_function node whose eager body submits every allreduce async
+    before synchronizing, so the coordinator packs all N gradients into
+    one flat-buffer wire collective.  Counted at the wire boundary
+    (_execute_response), with the background tick paused so fusion is
+    deterministic."""
+    from horovod_tpu.core import state as _state
+    from horovod_tpu.ops import collective as C
+
+    _state.global_state().bg_stop.set()  # inline drain fuses the queue
+    responses = []
+    real = C._execute_response
+
+    def counting(resp, ops):
+        responses.append(sorted(o.name for o in ops))
+        return real(resp, ops)
+
+    C._execute_response = counting
+    try:
+        n_params = 10
+        ws = [tf.Variable([float(i + 1), 2.0]) for i in range(n_params)]
+
+        @tf.function
+        def step():
+            with hvdtf.DistributedGradientTape(tf.GradientTape()) as tape:
+                loss = tf.add_n([tf.reduce_sum(w * w) for w in ws])
+            return tape.gradient(loss, ws)
+
+        grads = step()
+    finally:
+        C._execute_response = real
+    for w, g in zip(ws, grads):
+        np.testing.assert_allclose(g.numpy(), 2.0 * w.numpy(), rtol=1e-6)
+    # All N gradients crossed the wire in ONE fused collective.
+    fused = [r for r in responses if len(r) > 1]
+    assert len(fused) == 1, responses
+    assert len(fused[0]) == n_params, responses
+
+
+def test_tf_grouped_allreduce_eager_and_graph(hvd):
+    """grouped_allreduce (torch-frontend parity): correct values in
+    eager mode, and inside tf.function the group is ONE py_function
+    node / one fused wire collective."""
+    from horovod_tpu.core import state as _state
+    from horovod_tpu.ops import collective as C
+
+    xs = [tf.constant([float(i + 1)] * 3) for i in range(5)]
+    outs = hvdtf.grouped_allreduce(xs, average=False, name="tf.grp")
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(
+            o.numpy(), (i + 1.0) * hvd.size(), rtol=1e-6)
+
+    _state.global_state().bg_stop.set()  # deterministic fusion
+    responses = []
+    real = C._execute_response
+
+    def counting(resp, ops):
+        responses.append([o.name for o in ops])
+        return real(resp, ops)
+
+    C._execute_response = counting
+    try:
+        @tf.function
+        def f(*ts):
+            return hvdtf.grouped_allreduce(list(ts), average=True,
+                                           name="tf.grp.fn")
+
+        outs = f(*xs)
+    finally:
+        C._execute_response = real
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.numpy(), i + 1.0, rtol=1e-6)
+    fused = [r for r in responses if len(r) > 1]
+    assert len(fused) == 1 and len(fused[0]) == 5, responses
