@@ -264,6 +264,26 @@ def test_allreduce_op_adasum_matches_reference(hvd):
     np.testing.assert_allclose(out[0], np.ones(n), rtol=1e-6)
 
 
+def test_allreduce_op_adasum_vhdd_matches_reference(hvd):
+    """Vectors past the dispatch threshold (2n elements) take the
+    bandwidth-optimal VHDD kernel (~2|v| wire vs the ladder's
+    log2(n)|v|, ops/collective.py _adasum_vhdd); it computes the same
+    recursive pairwise tree as the spec.  103 % 8 != 0 exercises the
+    pad-to-n path, and orthogonal contributions still degenerate to the
+    plain sum."""
+    n = hvd.size()
+    rng = np.random.RandomState(11)
+    vals = rng.normal(size=(n, 103)).astype(np.float32)
+    out = np.asarray(hvd.allreduce(hvd.shard(jnp.asarray(vals)),
+                                   op=hvd.Adasum, name="vhdd.big"))
+    want = _adasum_reference(list(vals))
+    np.testing.assert_allclose(out[0], want, rtol=1e-4, atol=1e-5)
+    eye = np.eye(n, 4 * n, dtype=np.float32)  # orthogonal, size 4n > 2n
+    out = np.asarray(hvd.allreduce(hvd.shard(jnp.asarray(eye)),
+                                   op=hvd.Adasum, name="vhdd.orth"))
+    np.testing.assert_allclose(out[0], eye.sum(0), rtol=1e-6, atol=1e-6)
+
+
 def test_allreduce_op_argument_validation(hvd):
     with pytest.raises(ValueError, match="not both"):
         hvd.allreduce(jnp.ones((2,)), average=True, op=hvd.Sum)
