@@ -37,12 +37,31 @@ class BroadcastGlobalVariablesCallback(Callback):
                 self.trainer.model_state, root_rank=self.root_rank)
 
 
+def _average_metric(allreduce_fn, metric: str, value):
+    """Allreduce-average one logged metric; returns None for values that
+    must pass through untouched (strings, objects).  The reference
+    averages ANY logged value (keras/callbacks.py:37-87), so arrays
+    (per-class accuracies, confusion rows) average too — scalars come
+    back as Python floats (the historical contract), arrays as float32
+    ndarrays."""
+    try:
+        arr = np.asarray(value)
+    except Exception:
+        return None
+    if arr.dtype.kind not in "biuf":
+        return None
+    red = allreduce_fn(arr.astype(np.float32, copy=False), average=True,
+                       name=f"metric.{metric}")
+    return float(np.asarray(red)) if arr.ndim == 0 else np.asarray(red)
+
+
 class MetricAverageCallback(Callback):
     """Average epoch metrics across replicas at epoch end, in place, so
     metric-driven callbacks (early stopping, LR plateau) see global values
     (≙ keras/callbacks.py:37-87).  Metrics are reduced in sorted-name order
     for cross-process determinism, as the reference does
-    (keras/callbacks.py:72-73)."""
+    (keras/callbacks.py:72-73).  Any numeric log averages — scalars AND
+    arrays; non-numeric values pass through."""
 
     def on_epoch_end(self, epoch: int, logs=None) -> None:
         from .ops import collective as C
@@ -50,11 +69,9 @@ class MetricAverageCallback(Callback):
         if not logs:
             return
         for metric in sorted(logs.keys()):
-            value = logs[metric]
-            if isinstance(value, (int, float, np.floating)):
-                logs[metric] = float(C.allreduce(
-                    np.asarray(value, np.float32), average=True,
-                    name=f"metric.{metric}"))
+            red = _average_metric(C.allreduce, metric, logs[metric])
+            if red is not None:
+                logs[metric] = red
 
 
 class LearningRateScheduleCallback(Callback):

@@ -208,15 +208,19 @@ def _make_callbacks():
     class MetricAverageCallback(keras.callbacks.Callback):
         """Average epoch metrics over all replicas before other callbacks
         (checkpointing, early stopping) read them
-        (≙ keras/callbacks.py:47-70)."""
+        (≙ keras/callbacks.py:47-70).  Any numeric log averages —
+        scalars AND arrays (the reference averages every logged value);
+        non-numeric values pass through."""
 
         def on_epoch_end(self, epoch, logs=None):
-            if logs:
-                for k, v in list(logs.items()):
-                    if isinstance(v, (int, float, np.floating, np.integer)):
-                        logs[k] = float(allreduce(
-                            np.asarray(v, np.float32),
-                            name=f"metric.{k}.{epoch}"))
+            from ..callbacks import _average_metric
+
+            if not logs:
+                return
+            for k in sorted(logs.keys()):
+                red = _average_metric(allreduce, k, logs[k])
+                if red is not None:
+                    logs[k] = red
 
     class LearningRateScheduleCallback(keras.callbacks.Callback):
         """Multiply the initial LR by ``multiplier`` over
