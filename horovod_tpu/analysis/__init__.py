@@ -1,0 +1,67 @@
+"""hvd-analyze — static + trace-time correctness tooling for horovod_tpu.
+
+Three cooperating passes (docs/analysis.md):
+
+* :mod:`.program` — trace-time collective-program signature verifier:
+  :func:`verify_program` proves cross-rank agreement of the traced
+  collective program over the control plane *before* any data-plane
+  work, and :class:`ProgramTracker` does the same automatically inside
+  the coordinator's negotiation path (``HVD_TPU_VERIFY_PROGRAM=1``).
+* :mod:`.lint` — AST lint pass over the codebase itself
+  (``python -m horovod_tpu.analysis [--strict] [paths]``): guarded_by
+  lock discipline, blocking calls under locks, rank-conditioned
+  collectives.
+* :mod:`.lockorder` — runtime lock-order (inversion) detector
+  (``HVD_TPU_LOCK_CHECK=1``): every internal runtime lock is created
+  through its factories; an acquisition closing a cycle in the global
+  lock-order graph raises :class:`~.lockorder.LockOrderError`
+  immediately, in whichever single-threaded test first exhibits the
+  ordering.
+"""
+
+from .lint import Finding, lint_paths, lint_sources  # noqa: F401
+from .lockorder import (  # noqa: F401
+    CheckedLock,
+    CheckedRLock,
+    LockOrderError,
+    make_lock,
+    make_rlock,
+)
+from .program import (  # noqa: F401
+    ProgramRecorder,
+    ProgramReport,
+    ProgramTracker,
+    SignatureEntry,
+    collective_source,
+    compare_signatures,
+    record_collective,
+    verify_program,
+)
+
+
+def main(argv=None) -> int:
+    """CLI: lint the given paths (default: the horovod_tpu package)."""
+    import argparse
+    import os
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="Lock-discipline + SPMD-divergence linter "
+                    "(hvd-analyze pass 2).")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to lint "
+                             "(default: the horovod_tpu package)")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 when any finding is reported")
+    args = parser.parse_args(argv)
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    findings = lint_paths(paths)
+    for f in findings:
+        print(f.render())
+    print(f"hvd-analyze lint: {len(findings)} finding(s) over "
+          f"{', '.join(paths)}", file=sys.stderr)
+    if findings and args.strict:
+        return 1
+    return 0

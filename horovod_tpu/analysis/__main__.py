@@ -1,0 +1,7 @@
+"""``python -m horovod_tpu.analysis`` — run the lint pass (see lint.py)."""
+
+import sys
+
+from . import main
+
+sys.exit(main())

@@ -1,0 +1,67 @@
+"""Version-compat shims over the jax API surface.
+
+The runtime targets current jax (``jax.shard_map`` with ``check_vma``),
+but containers pin older releases where the transform still lives at
+``jax.experimental.shard_map.shard_map`` and the replication checker is
+named ``check_rep`` (renamed in jax 0.6).  Everything routes through
+:func:`shard_map` so the version split lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6: public symbol with check_vma
+    _NEW_SHARD_MAP = getattr(jax, "shard_map", None)
+except Exception:  # noqa: BLE001 — deprecation shims can raise oddly
+    _NEW_SHARD_MAP = None
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the ``check_vma`` spelling on every
+    supported jax version."""
+    if _NEW_SHARD_MAP is not None:
+        return _NEW_SHARD_MAP(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` (jax >= 0.5); older jax exposes the same
+    static extent through ``jax.core.axis_frame`` (which returns the
+    bare size int on 0.4.x)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return getattr(frame, "size", frame)
+
+
+def scan(body, init, xs, length=None):
+    """``lax.scan`` for loops that must differentiate inside a
+    ``shard_map``: jax 0.4.x's experimental shard_map cannot transpose
+    scan under ``check_rep=False`` (a ``_SpecError`` on the carry), so
+    on those versions the loop unrolls — same math, larger XLA program.
+    Current jax gets the real scan."""
+    if _NEW_SHARD_MAP is not None:
+        return jax.lax.scan(body, init, xs, length=length)
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(xs)
+    n = int(length) if length is not None else int(leaves[0].shape[0])
+    carry = init
+    ys = []
+    for i in range(n):
+        xi = jax.tree_util.tree_map(lambda a: a[i], xs) \
+            if leaves else xs
+        carry, y = body(carry, xi)
+        ys.append(y)
+    if not ys or all(y is None for y in ys):
+        stacked = None
+    else:
+        stacked = jax.tree_util.tree_map(
+            lambda *a: jnp.stack(a), *ys)
+    return carry, stacked

@@ -40,6 +40,8 @@ from typing import Any, Optional
 
 import jax
 
+from ..analysis import lockorder as _lockorder
+
 # Name of the one-dimensional mesh axis all Horovod-style collectives run
 # over.  Mirrors the single flat rank space of MPI_COMM_WORLD.
 REPLICA_AXIS = "hvd"
@@ -97,8 +99,12 @@ class _GlobalState:
     # coordinator-side only — fusion decisions are made there.
     autotuner: Any = None
     # Registered process sets (ops.process_set.ProcessSet) by id; id 0
-    # (the global set) is implicit and never stored here.
+    # (the global set) is implicit and never stored here.  Registered/
+    # removed by user threads, read by the drain tick and the
+    # controller's receive threads.
+    # guarded_by: lock
     process_sets: dict = field(default_factory=dict)
+    # guarded_by: lock
     next_process_set_id: int = 1
     # Timeline (utils.timeline.Timeline) when HOROVOD_TIMELINE is set.
     timeline: Any = None
@@ -116,7 +122,11 @@ class _GlobalState:
     # response (the last joining rank).
     joining: bool = False
     join_result: Optional[int] = None
-    lock: threading.RLock = field(default_factory=threading.RLock)
+    # Reentrant: init() holds it across nested helpers.  Created through
+    # the hvd-analyze factory so HVD_TPU_LOCK_CHECK=1 puts it on the
+    # lock-order graph (analysis/lockorder.py).
+    lock: threading.RLock = field(
+        default_factory=lambda: _lockorder.make_rlock("GlobalState.lock"))
 
 
 _state = _GlobalState()
@@ -251,10 +261,10 @@ def init(devices=None) -> None:
                     _state.coordinator.set_fusion_threshold(threshold)
                 # Per-process-set coordinators fuse independently; push
                 # the committed threshold to them too, else set
-                # collectives keep the construction-time value.  Snapshot:
-                # this runs on the drain tick thread while a user thread
-                # may be registering/removing sets.
-                for ps in list(_state.process_sets.values()):
+                # collectives keep the construction-time value.  Locked
+                # snapshot: this runs on the drain tick thread while a
+                # user thread may be registering/removing sets.
+                for ps in process_sets_snapshot():
                     if ps.coordinator is not None:
                         ps.coordinator.set_fusion_threshold(threshold)
 
@@ -347,6 +357,21 @@ def shutdown() -> None:
         _state.multiprocess = False
         _state.shutdown = True
         _state.initialized = False
+
+
+def get_process_set(psid: int):
+    """The registered ProcessSet for ``psid`` (None when unknown), read
+    under the state lock — the registry is mutated by user threads while
+    the drain tick and the controller's receive threads read it."""
+    with _state.lock:
+        return _state.process_sets.get(psid)
+
+
+def process_sets_snapshot() -> list:
+    """Locked snapshot of the registered process sets (same rationale
+    as :func:`get_process_set`)."""
+    with _state.lock:
+        return list(_state.process_sets.values())
 
 
 def _check_initialized() -> None:

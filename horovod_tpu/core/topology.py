@@ -36,6 +36,8 @@ from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
 import jax
+
+from . import compat as _compat
 import numpy as np
 
 # Canonical axis names.  ``REPLICA_AXIS`` ("hvd") from core.state is the
@@ -216,7 +218,7 @@ def make_hybrid_mesh(config: Optional[ParallelConfig] = None,
 
 def axis_size(axis: str) -> int:
     """Extent of ``axis`` inside traced code (static under shard_map)."""
-    return jax.lax.axis_size(axis)
+    return _compat.axis_size(axis)
 
 
 def axis_index(axis: str):

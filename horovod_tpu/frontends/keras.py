@@ -143,6 +143,14 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
         else cls(**config)
 
 
+# hvd-analyze: signature records from this binding carry source=keras
+# (analysis/program.py).
+from ..analysis.program import tag_source as _tag_source_factory
+
+_tag_source = _tag_source_factory("keras")
+
+
+@_tag_source
 def broadcast_global_variables(model_or_variables, root_rank: int = 0):
     """Broadcast all variables (model + optimizer) from ``root_rank``
     (≙ horovod/keras/__init__.py:94-102, minus the TF session).  Accepts
@@ -162,6 +170,7 @@ def broadcast_global_variables(model_or_variables, root_rank: int = 0):
         v.assign(np.asarray(_C.synchronize(h)))
 
 
+@_tag_source
 def allreduce(value, name: Optional[str] = None, average=None, op=None,
               process_set=None):
     """Allreduce a tensor-compatible value (≙ keras/__init__.py:105-118);
@@ -172,11 +181,13 @@ def allreduce(value, name: Optional[str] = None, average=None, op=None,
                                    process_set=process_set))
 
 
+@_tag_source
 def allgather(value, name: Optional[str] = None, process_set=None):
     return np.asarray(_C.allgather(np.asarray(value), name=name,
                                    process_set=process_set))
 
 
+@_tag_source
 def broadcast(value, root_rank: int, name: Optional[str] = None,
               process_set=None):
     return np.asarray(_C.broadcast(np.asarray(value), root_rank,

@@ -67,6 +67,7 @@ from ..core.state import (cross_rank, cross_size, init,  # noqa: F401
                           is_initialized, local_rank, local_size,
                           mpi_threads_supported, rank, shutdown, size,
                           start_timeline, stop_timeline)
+from ..analysis import program as _analysis_program
 from ..ops import collective as _C
 from ..ops import sparse as _S
 from ..ops.collective import (  # noqa: F401  (post-v0.13 API surface)
@@ -123,9 +124,19 @@ def _tracing() -> bool:
 def _graph_bridge(eager_fn, inputs, out_dtypes, op_name: str):
     """One ``tf.py_function`` node calling ``eager_fn`` with concrete
     tensors at graph-execution time (≙ the reference's AsyncOpKernel
-    enqueue from inside the execution engine, mpi_ops.cc:270-298)."""
+    enqueue from inside the execution engine, mpi_ops.cc:270-298).
+
+    The bridge body runs on a TF-managed thread, so the hvd-analyze
+    source tag (analysis/program.py) is applied here, inside the body,
+    not around the trace: signature records for in-graph collectives
+    still name this frontend."""
     tf = _tf()
-    flat = tf.py_function(func=eager_fn, inp=list(inputs),
+
+    def tagged(*args):
+        with _analysis_program.collective_source("tf"):
+            return eager_fn(*args)
+
+    flat = tf.py_function(func=tagged, inp=list(inputs),
                           Tout=list(out_dtypes),
                           name=op_name.replace(".", "_"))
     return flat if isinstance(flat, (list, tuple)) else [flat]
@@ -189,6 +200,12 @@ def _allreduce_in_graph(tensor, average, name: Optional[str],
     return out
 
 
+# Eager entry points record source=tf (analysis/program.py); in-graph
+# calls are tagged inside the py_function bridge instead.
+_tag_source = _analysis_program.tag_source("tf")
+
+
+@_tag_source
 def allreduce(tensor, average=None, name: Optional[str] = None,
               compression=None, op=None, process_set=None):
     """Allreduce a ``tf.Tensor``/``tf.Variable``/``tf.IndexedSlices``.
@@ -240,6 +257,7 @@ def allreduce(tensor, average=None, name: Optional[str] = None,
     return _wrap(compression.decompress(red, ctx), arr)
 
 
+@_tag_source
 def allgather(tensor, name: Optional[str] = None):
     if _tracing():
         op_name = name or _C._auto_name("allgather.tf.fn")
@@ -259,6 +277,7 @@ def allgather(tensor, name: Optional[str] = None):
     return _wrap(_C.allgather(arr, name=name), arr)
 
 
+@_tag_source
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     if _tracing():
         op_name = name or _C._auto_name("broadcast.tf.fn")
@@ -277,6 +296,7 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     return _wrap(_C.broadcast(arr, root_rank, name=name), arr)
 
 
+@_tag_source
 def broadcast_variables(variables: Iterable, root_rank: int = 0) -> None:
     """Assign every variable the root's value — launch all broadcasts
     async, then synchronize (the Torch frontend's pattern, matching the

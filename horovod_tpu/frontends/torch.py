@@ -40,6 +40,7 @@ import numpy as np
 
 import torch
 
+from ..analysis import program as _analysis_program
 from ..core import state as _state
 from ..core.features import (  # noqa: F401  (feature-query shims)
     cuda_built, gloo_built, mpi_built, mpi_enabled, nccl_built, rocm_built)
@@ -119,7 +120,9 @@ def _enqueue(kind: str, tensor: torch.Tensor, *, inplace: bool,
     if compression is not None:
         arr, ctx = compression.compress(arr)
     fn = getattr(_C, f"{kind}_async")
-    handle = fn(arr, name=name, **kw)
+    # hvd-analyze: signature records from this funnel name the binding.
+    with _analysis_program.collective_source("torch"):
+        handle = fn(arr, name=name, **kw)
     _inplace_targets[handle] = _Pending(tensor if inplace else None,
                                         tensor.dtype, compression, ctx)
     return handle
@@ -256,8 +259,9 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
     Multi-process returns the caller's received rows; single-process
     returns a list of per-replica tensors."""
     arr = _to_numpy(tensor)
-    out = _C.alltoall(arr, splits=splits, name=name,
-                      process_set=process_set)
+    with _analysis_program.collective_source("torch"):
+        out = _C.alltoall(arr, splits=splits, name=name,
+                          process_set=process_set)
     if isinstance(out, list):
         return [_from_numpy(np.asarray(o), tensor.dtype) for o in out]
     return _from_numpy(np.asarray(out), tensor.dtype)

@@ -49,6 +49,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..analysis import lockorder as _lockorder
+from ..analysis import program as _program
+from ..core import compat as _compat
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
 from . import wire
@@ -137,7 +140,7 @@ def _handle_lost_ranks(st, tp) -> None:
 
 
 # Autogenerated op names (≙ torch/mpi_ops.cc:35-40 "prefix.noname.<n>").
-_name_lock = threading.Lock()
+_name_lock = _lockorder.make_lock("collective._name_lock")
 _name_counters: Dict[str, int] = {}
 
 
@@ -339,7 +342,7 @@ def _build_kernels(mesh):
         # check_vma=False where the output is replicated by construction
         # (all_gather / masked-psum broadcast) but the static checker cannot
         # infer it.
-        return jax.jit(jax.shard_map(
+        return jax.jit(_compat.shard_map(
             fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
             check_vma=check_vma))
 
@@ -526,7 +529,7 @@ def _build_kernels(mesh):
                                          tiled=True),
             P(), P(), check_vma=False),
         # Per-replica [size, ...] + root -> replicated [...] = root's shard.
-        "bcast_pr": jax.jit(jax.shard_map(
+        "bcast_pr": jax.jit(_compat.shard_map(
             _bcast_block, mesh=mesh, in_specs=(P(REPLICA_AXIS), P()),
             out_specs=P(), check_vma=False)),
         # Reducescatter: per-replica [n, d0, ...] -> per-replica
@@ -657,8 +660,8 @@ class _OpQueue:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._ops: Dict[str, _QueuedOp] = {}
+        self._lock = _lockorder.make_lock("OpQueue._lock")
+        self._ops: Dict[str, _QueuedOp] = {}  # guarded_by: _lock
 
     def put(self, op: _QueuedOp) -> None:
         with self._lock:
@@ -692,7 +695,7 @@ class _OpQueue:
 
 
 _queue = _OpQueue()
-_drain_lock = threading.Lock()
+_drain_lock = _lockorder.make_lock("collective._drain_lock")
 
 # Background tick cadence — same 5 ms as the reference's coordinator loop
 # (operations.cc:1221).  The thread only serves *async* eager ops; sync ops
@@ -796,7 +799,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
 
     # Process-set responses execute over the set's sub-mesh with the
     # set's member count as the averaging denominator.
-    ps = st.process_sets.get(resp.process_set_id) \
+    ps = _state.get_process_set(resp.process_set_id) \
         if resp.process_set_id else None
     denom = st.size if ps is None else ps.size()
 
@@ -1001,7 +1004,7 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
     st = _state.global_state()
     tl = st.timeline
     hm = st.handle_manager
-    ps = st.process_sets.get(resp.process_set_id) \
+    ps = _state.get_process_set(resp.process_set_id) \
         if resp.process_set_id else None
     if ps is not None:
         if not ops:
@@ -1297,7 +1300,7 @@ def _drain() -> None:
                 tp.flush_unrouted()  # set requests that beat registration
                 meta = _queue.pending_meta()
                 resps = st.coordinator.poll_responses(meta)
-                for set_ps in list(st.process_sets.values()):
+                for set_ps in _state.process_sets_snapshot():
                     if set_ps.coordinator is not None:
                         resps += set_ps.coordinator.poll_responses(meta)
                 if resps:
@@ -1321,7 +1324,7 @@ def _drain() -> None:
             return
         meta = _queue.pending_meta()
         resps = st.coordinator.poll_responses(meta)
-        for set_ps in list(st.process_sets.values()):
+        for set_ps in _state.process_sets_snapshot():
             if set_ps.coordinator is not None:
                 resps += set_ps.coordinator.poll_responses(meta)
         for resp in resps:
@@ -1385,7 +1388,7 @@ def _enqueue(x, op: RequestType, name: Optional[str],
     if process_set is not None and process_set.process_set_id == 0:
         process_set = None  # hvd.global_process_set() ≡ the world
     if process_set is not None and \
-            process_set.process_set_id not in st.process_sets:
+            _state.get_process_set(process_set.process_set_id) is None:
         raise HorovodError(
             f"process set {process_set.process_set_id} is not registered "
             f"(was it removed, or created before a re-init?).")
@@ -1404,6 +1407,18 @@ def _enqueue(x, op: RequestType, name: Optional[str],
     item = wire.dtype_size(wire.dtype_of(c.dtype))
     s0 = c.shapes[0]
     nbytes = int(np.prod(s0, dtype=np.int64)) * item if s0 else item
+    # hvd-analyze signature capture (analysis/program.py): one record
+    # per collective, before negotiation, so verify_program can prove
+    # cross-rank agreement of the traced program ahead of the data
+    # plane.  Every frontend funnels through this point.
+    _program.record_collective(
+        op.name.lower(), name,
+        wire.dtype_name(wire.dtype_of(c.dtype)), s0,
+        reduce_op=(wire.reduce_op_name(red_op)
+                   if op in (RequestType.ALLREDUCE,
+                             RequestType.REDUCESCATTER) else ""),
+        process_set_id=0 if process_set is None
+        else process_set.process_set_id)
     handle = st.handle_manager.allocate(None, name=name)
     _queue.put(_QueuedOp(name=name, op=op, contrib=c, red_op=red_op,
                          root_rank=root_rank, handle=handle, nbytes=nbytes,
@@ -1508,9 +1523,11 @@ def remove_process_set(process_set) -> bool:
     psid = process_set.process_set_id
     if psid == 0:
         raise ValueError("the global process set cannot be removed")
-    if psid not in st.process_sets:
+    if _state.get_process_set(psid) is None:
         return False
     if st.multiprocess:
+        # The registration allgather is itself a blocking collective, so
+        # it must run OUTSIDE st.lock (blocking-under-lock lint rule).
         from .objects import allgather_object
 
         regs = allgather_object(psid, name=f"process_set.remove.{psid}")
@@ -1519,8 +1536,10 @@ def remove_process_set(process_set) -> bool:
                 f"remove_process_set must be called by every process for "
                 f"the same set; this process removed {psid} but the job "
                 f"removed {regs}.")
-    ps = st.process_sets.pop(psid)
-    ps.close()
+    with st.lock:
+        ps = st.process_sets.pop(psid, None)
+    if ps is not None:
+        ps.close()
     return True
 
 
@@ -1676,8 +1695,13 @@ def add_process_set(ranks):
     if bad:
         raise ValueError(
             f"process-set ranks {bad} outside [0, {bound}).")
-    psid = st.next_process_set_id
+    with st.lock:  # id counter + registry shared with drain/serve threads
+        psid = st.next_process_set_id
+        st.next_process_set_id = psid + 1
     if st.multiprocess:
+        # The registration allgather is itself a blocking collective, so
+        # it must run OUTSIDE st.lock (blocking-under-lock lint rule);
+        # a failed registration burns the id identically on every rank.
         from .objects import allgather_object
 
         regs = allgather_object((psid, ranks),
@@ -1688,7 +1712,6 @@ def add_process_set(ranks):
                 f"identical ranks in the same order; this process "
                 f"registered set {psid} as {list(ranks)} but the job "
                 f"registered {regs}.")
-    st.next_process_set_id = psid + 1
     ps = ProcessSet(psid, ranks)
     # Per-set coordinator wherever negotiation happens: the rank-0
     # controller in multi-process mode, the in-process coordinator
@@ -1699,7 +1722,8 @@ def add_process_set(ranks):
         ps.coordinator = Coordinator(
             size=ps.size(), fusion_threshold=st.fusion_threshold_bytes,
             timeline=st.timeline)
-    st.process_sets[psid] = ps
+    with st.lock:
+        st.process_sets[psid] = ps
     return ps
 
 

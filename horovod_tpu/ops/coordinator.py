@@ -40,6 +40,8 @@ from typing import Dict, List, Optional
 
 from . import wire
 from .wire import (DataType, Request, RequestType, Response, ResponseType)
+from ..analysis import lockorder as _lockorder
+from ..analysis import program as _program
 from ..native import lib as _native
 
 # Seconds a tensor may sit in negotiation before a stall warning
@@ -76,23 +78,23 @@ class PyCoordinator:
     def __init__(self, size: int, fusion_threshold: int):
         self.size = size
         self.fusion_threshold = fusion_threshold
-        self._lock = threading.Lock()
-        self.table: Dict[str, _PendingTensor] = {}
-        self.ready: List[str] = []
+        self._lock = _lockorder.make_lock("PyCoordinator._lock")
+        self.table: Dict[str, _PendingTensor] = {}  # guarded_by: _lock
+        self.ready: List[str] = []  # guarded_by: _lock
         # dtype per constructed response, for fusion compatibility checks
         # (the reference reads this from its TensorTable during the fusion
         # loop, operations.cc:1328-1374).
-        self._resp_dtype: Dict[str, DataType] = {}
+        self._resp_dtype: Dict[str, DataType] = {}  # guarded_by: _lock
         # ERROR responses queued by withdraw(); drained ahead of the ready
         # tensors by poll_responses.
-        self._withdrawn: List[Response] = []
+        self._withdrawn: List[Response] = []  # guarded_by: _lock
         # Ranks that called hvd.join() (post-v0.13 uneven-workload
         # barrier): they count as ready for every tensor and contribute
         # zeros at execution.  When all ranks joined, a JOIN response
         # releases them carrying the last joining rank.
-        self.joined: set = set()
-        self._last_joined: int = -1
-        self._join_release: List[Response] = []
+        self.joined: set = set()  # guarded_by: _lock
+        self._last_joined: int = -1  # guarded_by: _lock
+        self._join_release: List[Response] = []  # guarded_by: _lock
         self.shutdown = False
 
     # -- withdraw (round 4; no reference equivalent — the reference can
@@ -382,6 +384,10 @@ class PyCoordinator:
             release, self._join_release = self._join_release, []
             ready, self.ready = self.ready, []
             responses = [self._construct_response_locked(n) for n in ready]
+            # Snapshot for the fusion loop below: it runs outside the
+            # lock, and _resp_dtype is mutated by concurrent submits'
+            # construct_response (surfaced by the guarded-by lint pass).
+            dtypes = dict(self._resp_dtype)
         def nbytes_of(resp: Response) -> int:
             # Prefer the queue-side size table; fall back to the
             # shape × dtype the response itself carries (a process set
@@ -394,7 +400,7 @@ class PyCoordinator:
             n = 1
             for d in shape:
                 n *= int(d)
-            return n * wire.dtype_size(self._resp_dtype.get(
+            return n * wire.dtype_size(dtypes.get(
                 resp.tensor_names[0], DataType.FLOAT32))
 
         fused: List[Response] = list(withdrawn)
@@ -409,7 +415,7 @@ class PyCoordinator:
                 fused.append(r)
                 continue
             total = nbytes_of(r)
-            dtype = self._resp_dtype.get(r.tensor_names[0])
+            dtype = dtypes.get(r.tensor_names[0])
             j = i
             while j < len(responses):
                 nxt = responses[j]
@@ -417,7 +423,7 @@ class PyCoordinator:
                         and nxt.devices == r.devices
                         and nxt.reduce_op == r.reduce_op
                         and nxt.process_set_id == r.process_set_id
-                        and self._resp_dtype.get(nxt.tensor_names[0]) == dtype
+                        and dtypes.get(nxt.tensor_names[0]) == dtype
                         and total + nbytes_of(nxt)
                         <= self.fusion_threshold):
                     total += nbytes_of(nxt)
@@ -427,9 +433,10 @@ class PyCoordinator:
                 else:
                     j += 1
             fused.append(r)
-        for r in fused:
-            for n in r.tensor_names:
-                self._resp_dtype.pop(n, None)
+        with self._lock:
+            for r in fused:
+                for n in r.tensor_names:
+                    self._resp_dtype.pop(n, None)
         # The JOIN release comes LAST: joined ranks must execute this
         # batch's data responses (with zero contributions) before being
         # released from join().
@@ -442,14 +449,17 @@ class PyCoordinator:
         now = time.monotonic() if now is None else now
         warnings = []
         with self._lock:
-            items = list(self.table.items())
-        for name, entry in items:
-            if now - entry.first_seen > threshold:
-                ready = sorted(entry.ranks)
-                missing = sorted(set(range(self.size)) - entry.ranks)
+            # Copy the rank sets too: submit() mutates them under the
+            # lock while this report renders (guarded-by lint pass).
+            items = [(name, entry.first_seen, set(entry.ranks))
+                     for name, entry in self.table.items()]
+        for name, first_seen, ranks in items:
+            if now - first_seen > threshold:
+                ready = sorted(ranks)
+                missing = sorted(set(range(self.size)) - ranks)
                 warnings.append(
                     f"Tensor {name} has been pending for "
-                    f"{now - entry.first_seen:.0f}s; ready replicas: {ready}; "
+                    f"{now - first_seen:.0f}s; ready replicas: {ready}; "
                     f"waiting on replicas: {missing}. One or more replicas "
                     f"submitted this collective and are waiting for the "
                     f"remaining replicas to do the same.")
@@ -465,8 +475,11 @@ class PyCoordinator:
         self.shutdown = True
 
     def close(self) -> None:
-        self.table.clear()
-        self.ready.clear()
+        # Locked: shutdown() can close while the drain thread is mid-poll
+        # (surfaced by the guarded-by lint pass).
+        with self._lock:
+            self.table.clear()
+            self.ready.clear()
 
 
 class NativeCoordinator:
@@ -540,7 +553,14 @@ class NativeCoordinator:
 
 class Coordinator:
     """Facade selecting the native coordinator when built, Python otherwise,
-    and layering the timeline + stderr stall reporting over either."""
+    and layering the timeline + stderr stall reporting over either.
+
+    With ``HVD_TPU_VERIFY_PROGRAM=1`` it also runs the hvd-analyze
+    program tracker (analysis/program.py) over the request streams: a
+    rank-divergent program ORDER — which the name-keyed request table
+    below can only ever stall on — is converted into an immediate ERROR
+    response naming the first divergent entry, before any data-plane
+    work."""
 
     def __init__(self, size: int, fusion_threshold: int, timeline=None):
         self.timeline = timeline
@@ -553,12 +573,29 @@ class Coordinator:
         else:
             self._impl = PyCoordinator(size, fusion_threshold)
         self.size = size
+        self._tracker = (_program.ProgramTracker(size)
+                         if _program.program_check_enabled() else None)
+        self._tracker_lock = _lockorder.make_lock("Coordinator._tracker")
+        # guarded_by: _tracker_lock
+        self._program_errors: List[Response] = []
 
     def submit(self, req: Request) -> bool:
         if self.timeline is not None:
             self.timeline.negotiate_rank_ready(req.tensor_name,
                                                req.request_rank,
                                                first=req.request_rank == 0)
+        if self._tracker is not None:
+            # JOIN disables the tracker (join legalizes rank-divergent
+            # programs — see ProgramTracker).
+            diag = self._tracker.feed(req)
+            if diag is not None:
+                # Fail the divergent op on every rank at the next poll —
+                # negotiation can never complete for a reordered stream.
+                with self._tracker_lock:
+                    self._program_errors.append(Response(
+                        ResponseType.ERROR, [req.tensor_name],
+                        error_message=diag,
+                        process_set_id=req.process_set_id))
         done = self._impl.submit(req)
         if done and self.timeline is not None:
             self.timeline.negotiate_end(req.tensor_name)
@@ -576,7 +613,12 @@ class Coordinator:
             self._last_stall_check = now
             for w in self._impl.check_stalled(now):
                 print(f"WARNING: {w}", file=sys.stderr)
-        return self._impl.poll_responses(sizes_bytes)
+        resps = self._impl.poll_responses(sizes_bytes)
+        with self._tracker_lock:
+            if self._program_errors:
+                resps = self._program_errors + resps
+                self._program_errors = []
+        return resps
 
     def check_stalled(self, now=None, threshold=STALL_WARNING_SECONDS):
         return self._impl.check_stalled(now, threshold)

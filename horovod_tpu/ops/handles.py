@@ -52,8 +52,10 @@ class HandleManager:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._handles: Dict[int, Handle] = {}
+        from ..analysis import lockorder as _lockorder
+
+        self._lock = _lockorder.make_lock("HandleManager._lock")
+        self._handles: Dict[int, Handle] = {}  # guarded_by: _lock
         self._native = _native.handle_manager_create()
 
     def allocate(self, result: Any, finalizer: Optional[Callable] = None,

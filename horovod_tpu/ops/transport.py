@@ -31,6 +31,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from . import wire
+from ..analysis import lockorder as _lockorder
 from .wire import DEAD_PEER_MARKER, Request, Response, ResponseType
 
 FRAME_HELLO = 0       # worker→controller: <i rank><H len><hostname>
@@ -43,6 +44,14 @@ FRAME_WITHDRAW = 5    # worker→controller: <i rank><H len><name><H psid> —
                       # the rank's synchronize timed out on <name>; the
                       # coordinator (of process set psid; 0 = global)
                       # fails the op for the whole group
+FRAME_SIGNATURE = 6   # worker→controller: <i rank><I round> + packed
+                      # program signature (analysis/program.py
+                      # verify_program); the round counter pairs
+                      # payloads with their verify call so a stale
+                      # signature left by a timed-out round can never
+                      # complete a later one
+FRAME_SIGRESULT = 7   # controller→worker: <I round><B ok> + utf-8
+                      # diagnostic
 
 _HDR = struct.Struct("<IB")
 
@@ -115,8 +124,17 @@ class ControllerTransport:
         # Requests whose process set was not yet registered on arrival
         # (registration race): retried by flush_unrouted.
         self._unrouted: List = []
-        self._lock = threading.Lock()
-        self._send_lock = threading.Lock()
+        self._lock = _lockorder.make_lock("ControllerTransport._lock")
+        self._send_lock = _lockorder.make_lock(
+            "ControllerTransport._send_lock")
+        # verify_program rendezvous: round → rank → signature payload,
+        # collected by the receive threads, consumed by rank 0's
+        # verify_program (analysis/program.py).  Keyed by round so a
+        # straggler from a timed-out round is never mis-paired.
+        self._sig_cond = threading.Condition(self._lock)
+        # guarded_by: _sig_cond
+        self._signatures: Dict[int, Dict[int, bytes]] = {}
+        self._sig_round = 0  # guarded_by: _sig_cond
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("0.0.0.0", port))
@@ -205,6 +223,12 @@ class ControllerTransport:
                             (time.monotonic() + 5.0, req))
             elif ftype == FRAME_SHUTDOWN:
                 self.shutdown_requested.set()
+            elif ftype == FRAME_SIGNATURE:
+                srank, srnd = struct.unpack_from("<iI", payload)
+                with self._sig_cond:
+                    self._signatures.setdefault(srnd, {})[srank] = \
+                        payload[8:]
+                    self._sig_cond.notify_all()
             elif ftype == FRAME_WITHDRAW:
                 (wrank,) = struct.unpack_from("<i", payload)
                 (nlen,) = struct.unpack_from("<H", payload, 4)
@@ -225,7 +249,9 @@ class ControllerTransport:
             return self.coordinator
         from ..core import state as _st
 
-        ps = _st.global_state().process_sets.get(psid)
+        # Locked read: this runs on a receive thread while user threads
+        # register/remove sets (guarded-by lint finding).
+        ps = _st.get_process_set(psid)
         return None if ps is None else ps.coordinator
 
     def _try_submit(self, req: Request) -> bool:
@@ -255,6 +281,55 @@ class ControllerTransport:
         if keep:
             with self._lock:
                 self._unrouted = keep + self._unrouted
+
+    # -- verify_program rendezvous (analysis/program.py) -------------------
+    def collect_signatures(self, own: bytes, timeout: float) -> Dict[int,
+                                                                     bytes]:
+        """Wait until every rank's program signature for THIS round
+        arrived (rank 0's is ``own``), then return the payloads.  Rounds
+        advance once per call on every rank in lockstep, so a straggler
+        payload from a timed-out round sits under its own round key and
+        can never complete a later round.  A rank that died mid-round
+        surfaces as a TimeoutError naming it."""
+        deadline = time.monotonic() + timeout
+        with self._sig_cond:
+            self._sig_round += 1
+            rnd = self._sig_round
+            this_round = self._signatures.setdefault(rnd, {})
+            this_round[0] = own
+            try:
+                while len(this_round) < self.num_processes:
+                    remaining = deadline - time.monotonic()
+                    missing = sorted(set(range(self.num_processes))
+                                     - set(this_round))
+                    if remaining <= 0 or (self.lost_ranks
+                                          and set(missing) <=
+                                          set(self.lost_ranks)):
+                        raise TimeoutError(
+                            f"verify_program: ranks {missing} did not "
+                            f"send their collective-program signature "
+                            f"within {timeout:.0f}s (did they call "
+                            f"verify_program too?)")
+                    self._sig_cond.wait(min(remaining, 0.1))
+                return dict(this_round)
+            finally:
+                # Drop this and any earlier (abandoned) rounds.
+                for r in [r for r in self._signatures if r <= rnd]:
+                    del self._signatures[r]
+
+    def broadcast_signature_result(self, error: Optional[str]) -> None:
+        with self._sig_cond:
+            rnd = self._sig_round
+        payload = struct.pack("<IB", rnd, 0 if error else 1) + (
+            error or "").encode("utf-8")
+        with self._send_lock:
+            with self._lock:
+                conns = list(self._conns.values())
+            for conn in conns:
+                try:
+                    _send_frame(conn, FRAME_SIGRESULT, payload)
+                except OSError:
+                    pass  # worker already gone; its own timeout reports
 
     # -- controller-side API used by the drain loop ------------------------
     def submit(self, req: Request) -> None:
@@ -305,6 +380,11 @@ class WorkerTransport:
         self.shutdown_received = threading.Event()
         self._closing = False
         self._responses: "queue.Queue[List[Response]]" = queue.Queue()
+        # verify_program verdicts (FRAME_SIGRESULT) as (round, verdict);
+        # the round counter lets exchange_signature discard a stale
+        # verdict left queued by a timed-out earlier round.
+        self._sig_results: "queue.Queue" = queue.Queue()
+        self._sig_round = 0
         deadline = time.monotonic() + connect_timeout
         last_err: Optional[Exception] = None
         while True:
@@ -322,7 +402,7 @@ class WorkerTransport:
                 time.sleep(0.1)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._send_lock = threading.Lock()
+        self._send_lock = _lockorder.make_lock("WorkerTransport._send_lock")
         hb = (hostname or socket.gethostname()).encode("utf-8")
         _send_frame(self._sock, FRAME_HELLO,
                     struct.pack("<i", rank) + struct.pack("<H", len(hb)) + hb)
@@ -379,6 +459,12 @@ class WorkerTransport:
                         f"rank-0 controller {DEAD_PEER_MARKER} while "
                         "collectives were pending.")])
                 return
+            if ftype == FRAME_SIGRESULT:
+                (rnd,) = struct.unpack_from("<I", payload)
+                ok = payload[4:5] == b"\x01"
+                self._sig_results.put(
+                    (rnd, None if ok else payload[5:].decode("utf-8")))
+                continue
             if ftype == FRAME_RESPONSES:
                 resps = wire.unpack_response_list(payload)
                 # Controller-initiated shutdown arrives as a SHUTDOWN-type
@@ -396,6 +482,35 @@ class WorkerTransport:
     def request_shutdown(self) -> None:
         with self._send_lock:
             _send_frame(self._sock, FRAME_SHUTDOWN)
+
+    def exchange_signature(self, payload: bytes,
+                           timeout: float) -> Optional[str]:
+        """Ship this rank's program signature to the controller and
+        block for THIS round's verdict: ``None`` = every rank agreed,
+        else the divergence diagnostic (analysis/program.py).  Rounds
+        advance once per call in lockstep with the controller; a stale
+        verdict queued by a timed-out earlier round is discarded."""
+        self._sig_round += 1
+        rnd = self._sig_round
+        with self._send_lock:
+            _send_frame(self._sock, FRAME_SIGNATURE,
+                        struct.pack("<iI", self.rank, rnd) + payload)
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"verify_program: rank {self.rank} got no verdict "
+                    f"from the controller within {timeout:.0f}s (did "
+                    f"every rank call verify_program?)")
+            try:
+                got_rnd, verdict = self._sig_results.get(
+                    timeout=remaining)
+            except queue.Empty:
+                continue
+            if got_rnd == rnd:
+                return verdict
+            # got_rnd < rnd: stale verdict from an abandoned round.
 
     def withdraw(self, name: str, process_set_id: int = 0) -> None:
         """Tell the controller this rank gave up waiting on ``name`` (its

@@ -24,6 +24,8 @@ from __future__ import annotations
 from typing import NamedTuple, Optional
 
 import jax
+
+from ..core import compat as _compat
 import jax.numpy as jnp
 
 
@@ -54,7 +56,7 @@ def init_moe_params(key, num_experts: int, d_model: int, d_hidden: int,
 def local_experts(params: dict, *, axis_name: str) -> dict:
     """Slice this device's expert shard (inside shard_map) from replicated
     full params; the router stays replicated."""
-    n = jax.lax.axis_size(axis_name)
+    n = _compat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
 
     def shard(leaf):
@@ -122,7 +124,7 @@ def moe_layer(x, params: dict, *, axis_name: str, num_experts: int,
         (e.g. via :func:`local_experts`).
       num_experts: global expert count E (must divide by the axis size).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = _compat.axis_size(axis_name)
     tokens, d_model = x.shape
     e_local = num_experts // n
     if e_local * n != num_experts:

@@ -19,6 +19,8 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+
+from ..core import compat as _compat
 import jax.numpy as jnp
 
 from ..core.topology import PIPE_AXIS
@@ -43,7 +45,7 @@ def gpipe(stage_fn: Callable, stage_params, x, *, num_microbatches: int,
       stage's results are summed across the axis, other stages contribute
       zeros — one psum at the end).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = _compat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     m = num_microbatches
     if x.shape[0] % m != 0:
@@ -78,7 +80,7 @@ def gpipe(stage_fn: Callable, stage_params, x, *, num_microbatches: int,
     ticks = jnp.arange(m + n - 1)
     recv0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
     outs0 = jnp.zeros_like(xs)
-    (_, outs), _ = jax.lax.scan(tick, (recv0, outs0), ticks)
+    (_, outs), _ = _compat.scan(tick, (recv0, outs0), ticks)
     # Only the last stage holds real outputs; share them with one psum.
     outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
     outs = jax.lax.psum(outs, axis_name)

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import compat as _compat
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
 from .data import DistributedOptimizer, allreduce_gradients
@@ -172,7 +173,7 @@ def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
             lambda x: jax.lax.pmean(x, REPLICA_AXIS), aux)
         return loss, grads, aux
 
-    sharded = jax.shard_map(
+    sharded = _compat.shard_map(
         per_replica, mesh=mesh,
         in_specs=(P(), P(), P(REPLICA_AXIS)),
         out_specs=(P(), P(), P()),
@@ -271,7 +272,7 @@ def make_parallel_train_step(loss_fn: Callable[..., Any], optimizer,
     ``batch_spec`` is the PartitionSpec (or pytree of specs) describing
     how the host batch is laid out over the mesh.
     """
-    sharded_loss = jax.shard_map(
+    sharded_loss = _compat.shard_map(
         loss_fn, mesh=mesh, in_specs=(P(), batch_spec), out_specs=P(),
         check_vma=False)
 
@@ -307,7 +308,7 @@ def make_eval_step(metric_fn: Callable[..., Any], mesh=None):
         return jax.tree_util.tree_map(
             lambda x: jax.lax.pmean(x, REPLICA_AXIS), m)
 
-    sharded = jax.shard_map(
+    sharded = _compat.shard_map(
         per_replica, mesh=mesh, in_specs=(P(), P(REPLICA_AXIS)),
         out_specs=P(), check_vma=False)
     return _throttle_on_cpu(jax.jit(sharded), mesh)

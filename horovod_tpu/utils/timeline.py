@@ -46,8 +46,10 @@ _PH_INSTANT = "i"
 
 class Timeline:
     def __init__(self, path: str):
+        from ..analysis import lockorder as _lockorder
+
         self._path = path
-        self._lock = threading.Lock()
+        self._lock = _lockorder.make_lock("Timeline._lock")
         self._native = None
         if _native.NATIVE and hasattr(_native.raw(), "hvd_timeline_create"):
             self._native = _native.raw().hvd_timeline_create(path.encode())

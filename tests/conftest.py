@@ -25,6 +25,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # bind the TF backend and hand the keras frontend symbolic tf.Tensors;
 # pin the JAX backend for every ordering.
 os.environ.setdefault("KERAS_BACKEND", "jax")
+# hvd-analyze lock-order detector on for the whole tier-1 suite (and,
+# via env inheritance, every multi-process scenario it launches): any
+# lock-acquisition cycle raises LockOrderError in whichever test first
+# exhibits the ordering (analysis/lockorder.py).  Must be set before
+# horovod_tpu creates its locks.
+os.environ.setdefault("HVD_TPU_LOCK_CHECK", "1")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

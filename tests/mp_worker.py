@@ -845,6 +845,89 @@ def scenario_elastic2(hvd):
     print(f"ELASTIC2_OK rank={rank}")
 
 
+def scenario_verify(hvd):
+    """verify_program across REAL processes (hvd-analyze pass 1): the
+    matching program verifies clean over the TCP control plane, then
+    every divergence kind — dtype, shape, order, count, and the
+    process-set wait-for CYCLE no runtime check can catch — fails at
+    verify time with a diagnostic naming the first divergent entry and
+    both ranks' records.  All cases run in ONE launch, and — true to
+    "verify BEFORE the data plane" — no collective is ever synchronized:
+    the divergent ops are enqueued async only, so every negotiation
+    either errors or stays pending (poisoned at shutdown) and the group
+    stays healthy between cases; verify_program's reset isolates each
+    round."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import HorovodError, verify_program
+    from horovod_tpu.analysis import program as _prog
+
+    rank = hvd.rank()
+
+    # Round 0 — identical signatures verify clean.  The roots diverge,
+    # but root_rank is deliberately OUTSIDE the signature (the runtime
+    # validator owns it): this also pins the verifier's scope.
+    _prog.recorder().clear()
+    hvd.broadcast_async(jnp.ones((2,)), root_rank=rank, name="v.same")
+    rep = verify_program()
+    assert rep.ranks == 2 and rep.entries == 1, rep
+    print(f"VERIFY_OK rank={rank}")
+
+    def expect(case: str, want: str, both_records: bool = True):
+        try:
+            verify_program()
+            raise AssertionError(f"case {case}: expected divergence")
+        except HorovodError as e:
+            assert want in str(e), (case, str(e))
+            if both_records:
+                assert "rank 0" in str(e) and "rank 1" in str(e), str(e)
+        print(f"VERIFY_DIVERGE_OK rank={rank} case={case}")
+
+    # dtype: same name, one rank traced float32, the other int32.
+    hvd.allreduce_async(jnp.ones(
+        (2,), jnp.float32 if rank == 0 else jnp.int32),
+        average=False, name="v.dtype")
+    expect("dtype", "Mismatched data types")
+
+    # shape: same name, rank-dependent shape.
+    hvd.allreduce_async(jnp.ones((2 + rank,)), average=False,
+                        name="v.shape")
+    expect("shape", "Mismatched tensor shapes")
+
+    # order: the two ranks enqueue the same two ops swapped — the
+    # name-keyed coordinator would stall on this forever.  (The dtype
+    # rides the rank so the swapped negotiations error out instead of
+    # completing into data-plane work this scenario never wants.)
+    dt = jnp.float32 if rank == 0 else jnp.int32
+    for n in (["v.a", "v.b"] if rank == 0 else ["v.b", "v.a"]):
+        hvd.allreduce_async(jnp.ones((2,), dt), average=False, name=n)
+    expect("order", "Mismatched tensor names")
+
+    # count: rank 1 traced one collective more than rank 0 (the common
+    # entry is signature-identical — divergent root only — so the
+    # count check, not a field diff, is what fires).
+    hvd.broadcast_async(jnp.ones((2,)), root_rank=rank, name="v.c0")
+    if rank == 1:
+        hvd.allreduce_async(jnp.ones((2,)), average=False, name="v.c1")
+    expect("count", "Rank-divergent collective count",
+           both_records=False)
+
+    # process-set cycle: rank 0 traces set-1-then-set-2, rank 1 the
+    # swap.  Each set's coordinator would see a perfectly consistent
+    # stream, so only the wait-for-graph check can catch the deadlock
+    # synchronous callers would hit.  Recorded through the public
+    # capture hook so the cycle stands alone in the signature
+    # (registering real sets would prepend its own collective rounds).
+    _prog.recorder().clear()
+    order = [("v.x", 1), ("v.y", 2)] if rank == 0 \
+        else [("v.y", 2), ("v.x", 1)]
+    for n, psid in order:
+        _prog.record_collective("allreduce", n, "float32", (2,),
+                                reduce_op="sum", process_set_id=psid)
+    expect("cycle", "Potential process-set deadlock cycle")
+    print(f"VERIFY_ALL_OK rank={rank}")
+
+
 def scenario_combo(hvd):
     """Run several NON-DESTRUCTIVE scenarios sequentially in ONE launch
     (``HVD_TPU_COMBO`` names them, comma-separated).  Every separate

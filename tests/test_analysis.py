@@ -1,0 +1,527 @@
+"""Tests for the hvd-analyze subsystem (horovod_tpu/analysis/).
+
+Three passes, three test groups:
+
+* lint — each rule catches a seeded violation, the waiver comment works,
+  and (the acceptance gate) the shipped tree itself is clean;
+* program — compare_signatures flags every divergence kind with the
+  exact reference-style label, the coordinator-side tracker converts a
+  reordered request stream into an immediate diagnostic, and
+  verify_program round-trips single-process;
+* lockorder — a seeded A→B / B→A inversion raises, consistent orders
+  and RLock reentrancy do not, and the factories honor
+  HVD_TPU_LOCK_CHECK.
+"""
+
+import os
+import threading
+import textwrap
+
+import numpy as np
+import pytest
+
+from horovod_tpu.analysis import lint as L
+from horovod_tpu.analysis import lockorder
+from horovod_tpu.analysis import program as prog
+from horovod_tpu.ops import wire
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "horovod_tpu")
+
+
+def _lint(src: str):
+    return L.lint_sources({"seed.py": textwrap.dedent(src)})
+
+
+# ---------------------------------------------------------------------------
+# lint: guarded-by
+# ---------------------------------------------------------------------------
+
+GUARDED_CLASS = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = []  # guarded_by: _lock
+
+        def good(self):
+            with self._lock:
+                self.items.append(1)
+
+        def also_good_locked(self):
+            self.items.append(2)
+"""
+
+
+def test_guarded_by_clean_when_locked():
+    assert _lint(GUARDED_CLASS) == []
+
+
+def test_guarded_by_breach_is_caught():
+    findings = _lint(GUARDED_CLASS + """
+        def bad(self):
+            return len(self.items)
+""")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "guarded-by"
+    assert "Box.items" in f.message and "_lock" in f.message
+
+
+def test_guarded_by_dataclass_field_and_producer_typing():
+    findings = _lint("""
+        import threading
+        from dataclasses import dataclass, field
+
+        @dataclass
+        class _GS:
+            # guarded_by: lock
+            registry: dict = field(default_factory=dict)
+            lock: object = None
+
+        _gs = _GS()
+
+        def global_state() -> _GS:
+            return _gs
+
+        def good():
+            st = global_state()
+            with st.lock:
+                return len(st.registry)
+
+        def bad():
+            st = global_state()
+            return len(st.registry)
+
+        def bad_module_var():
+            return _gs.registry
+    """)
+    assert [f.rule for f in findings] == ["guarded-by", "guarded-by"]
+    assert {"bad", "bad_module_var"} == {
+        f.message.split("(in ")[1].rstrip(")") for f in findings}
+
+
+def test_guarded_by_waiver_comment():
+    findings = _lint(GUARDED_CLASS + """
+        def waived(self):
+            return len(self.items)  # lint: ok(snapshot for debug dump)
+""")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# lint: blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_blocking_call_under_lock_is_caught():
+    findings = _lint("""
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def bad():
+            with _lock:
+                time.sleep(1.0)
+
+        def fine():
+            time.sleep(1.0)
+            with _lock:
+                pass
+    """)
+    assert len(findings) == 1
+    assert findings[0].rule == "blocking-under-lock"
+    assert "sleep" in findings[0].message
+
+
+def test_socket_recv_under_lock_is_caught():
+    findings = _lint("""
+        import threading
+
+        _lock = threading.Lock()
+
+        def bad(sock):
+            with _lock:
+                return sock.recv(4)
+    """)
+    assert [f.rule for f in findings] == ["blocking-under-lock"]
+
+
+# ---------------------------------------------------------------------------
+# lint: rank-conditioned-collective
+# ---------------------------------------------------------------------------
+
+def test_rank_conditioned_collective_is_caught():
+    findings = _lint("""
+        from horovod_tpu import allreduce, rank
+
+        def bad(x):
+            if rank() == 0:
+                return allreduce(x)
+            return x
+
+        def fine(x):
+            if rank() == 0:
+                print("root")
+            return allreduce(x)
+    """)
+    assert len(findings) == 1
+    assert findings[0].rule == "rank-conditioned-collective"
+    assert "allreduce" in findings[0].message
+
+
+def test_rank_conditioned_else_branch_is_caught():
+    findings = _lint("""
+        from horovod_tpu import broadcast, local_rank
+
+        def bad(x):
+            if local_rank() != 0:
+                pass
+            else:
+                return broadcast(x, 0)
+    """)
+    assert [f.rule for f in findings] == ["rank-conditioned-collective"]
+
+
+# ---------------------------------------------------------------------------
+# lint: the shipped tree is clean (the CI --strict gate)
+# ---------------------------------------------------------------------------
+
+def test_shipped_tree_has_no_findings():
+    findings = L.lint_paths([PKG])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# program: compare_signatures divergence kinds
+# ---------------------------------------------------------------------------
+
+def _entry(seq=0, op="allreduce", name="x", dtype="float32", shape=(2,),
+           red="SUM", ps=0, source=""):
+    return prog.SignatureEntry(seq, op, name, dtype, tuple(shape), red,
+                               ps, source)
+
+
+def test_compare_identical_programs_ok():
+    p = [_entry(0), _entry(1, name="y")]
+    assert prog.compare_signatures({0: list(p), 1: list(p)}) is None
+
+
+def test_compare_dtype_divergence():
+    msg = prog.compare_signatures({
+        0: [_entry()], 1: [_entry(dtype="int32")]})
+    assert "Mismatched data types" in msg
+    assert "entry #0" in msg
+    assert "rank 0" in msg and "rank 1" in msg
+    assert "float32" in msg and "int32" in msg
+
+
+def test_compare_shape_divergence():
+    msg = prog.compare_signatures({
+        0: [_entry(shape=(2,))], 1: [_entry(shape=(3,))]})
+    assert "Mismatched tensor shapes" in msg
+
+
+def test_compare_allgather_ragged_dim0_is_legal():
+    sigs = {0: [_entry(op="allgather", red="", shape=(1, 4))],
+            1: [_entry(op="allgather", red="", shape=(3, 4))]}
+    assert prog.compare_signatures(sigs) is None
+    sigs[1] = [_entry(op="allgather", red="", shape=(3, 5))]
+    assert "Mismatched tensor shapes" in prog.compare_signatures(sigs)
+
+
+def test_compare_op_and_reduce_op_divergence():
+    assert "Mismatched collective operations" in prog.compare_signatures(
+        {0: [_entry()], 1: [_entry(op="broadcast", red="")]})
+    assert "Mismatched reduce operations" in prog.compare_signatures(
+        {0: [_entry(red="SUM")], 1: [_entry(red="MIN")]})
+
+
+def test_compare_order_divergence():
+    msg = prog.compare_signatures({
+        0: [_entry(0, name="a"), _entry(1, name="b")],
+        1: [_entry(0, name="b"), _entry(1, name="a")]})
+    assert "Mismatched tensor names" in msg
+    assert "rank-divergent program order" in msg
+
+
+def test_compare_count_divergence():
+    msg = prog.compare_signatures({
+        0: [_entry(0)],
+        1: [_entry(0), _entry(1, name="extra")]})
+    assert "Rank-divergent collective count" in msg
+    assert "rank 0 recorded 1" in msg and "rank 1 recorded 2" in msg
+    assert "extra" in msg  # the first unmatched entry is named
+
+
+def test_compare_process_set_cycle():
+    """X in set 1 before Y in set 2 on rank 0, the swap on rank 1: each
+    set's coordinator sees a consistent stream, so only the wait-for
+    cycle check can catch it."""
+    x0, y0 = _entry(0, name="x", ps=1), _entry(1, name="y", ps=2)
+    y1, x1 = _entry(0, name="y", ps=2), _entry(1, name="x", ps=1)
+    msg = prog.compare_signatures({0: [x0, y0], 1: [y1, x1]})
+    assert "Potential process-set deadlock cycle" in msg
+    assert "1 -> 2 -> 1" in msg
+    assert "deadlock" in msg
+
+
+def test_compare_offset_windows_align_by_seq():
+    """Bounded windows that slid by different amounts (one rank traced
+    an extra op before both overflowed) must pair entries by ABSOLUTE
+    seq: the overlap here agrees entry-for-entry, so only the count
+    divergence is reported — not a bogus name mismatch from
+    positionally zipping offset lists."""
+    # rank 0 window: seqs 10..14 of ops a10..a14; rank 1 traced one
+    # extra early op, so its window holds seqs 11..15 = a10..a14 at
+    # seq+1 plus nothing new — i.e. the same logical tail.
+    win0 = [_entry(s, name=f"op.{s}") for s in range(10, 15)]
+    win1 = [_entry(s, name=f"op.{s}") for s in range(11, 15)]
+    msg = prog.compare_signatures({0: win0, 1: win1},
+                                  totals={0: 15, 1: 16})
+    assert "Rank-divergent collective count" in msg
+    assert "Mismatched tensor names" not in msg
+
+
+def test_cross_validate_digest_fast_path():
+    p = [_entry(0), _entry(1, name="y")]
+    a = prog.pack_program(0, p, 2)
+    b = prog.pack_program(1, p, 2)
+    assert prog.cross_validate({0: a, 1: b}) is None
+    c = prog.pack_program(1, [p[0], _entry(1, name="z")], 2)
+    assert "Mismatched tensor names" in prog.cross_validate({0: a, 1: c})
+
+
+# ---------------------------------------------------------------------------
+# program: coordinator-side tracker + facade hook
+# ---------------------------------------------------------------------------
+
+def _req(rank, name, dtype=wire.DataType.FLOAT32, shape=(2,),
+         rt=wire.RequestType.ALLREDUCE):
+    return wire.Request(request_rank=rank, request_type=rt,
+                        tensor_type=dtype, tensor_name=name,
+                        tensor_shape=tuple(shape),
+                        reduce_op=wire.ReduceOp.SUM)
+
+
+def test_program_tracker_flags_reordered_streams():
+    t = prog.ProgramTracker(2)
+    assert t.feed(_req(0, "a")) is None
+    assert t.feed(_req(0, "b")) is None
+    diag = t.feed(_req(1, "b"))  # rank 1's entry #0 vs rank 0's "a"
+    assert diag is not None and "Mismatched tensor names" in diag
+    assert "'a'" in diag and "'b'" in diag
+
+
+def test_program_tracker_trims_matching_prefix():
+    t = prog.ProgramTracker(2)
+    for i in range(100):
+        assert t.feed(_req(0, f"op.{i}")) is None
+        assert t.feed(_req(1, f"op.{i}")) is None
+    # The cross-checked prefix is dropped; memory stays O(skew).
+    assert t._base == 100
+    assert all(len(s) == 0 for s in t._streams)
+
+
+def test_program_tracker_disabled_by_join():
+    """hvd.join() legalizes rank-divergent programs: a JOIN request must
+    disarm the tracker so a rejoining rank is never positionally
+    compared against entries peers issued during its absence."""
+    t = prog.ProgramTracker(2)
+    assert t.feed(_req(0, "epoch1.g8")) is None
+    assert t.feed(_req(0, "epoch1.g9")) is None  # rank 1 ran out of data
+    join = wire.Request(request_rank=1,
+                        request_type=wire.RequestType.JOIN,
+                        tensor_type=wire.DataType.UINT8,
+                        tensor_name="hvd.join")
+    assert t.feed(join) is None
+    # Rank 1 resumes next epoch at a different absolute position: no
+    # false divergence on the healthy uneven workload.
+    assert t.feed(_req(1, "epoch2.g0")) is None
+    assert t.feed(_req(0, "epoch2.g0")) is None
+
+
+def test_program_tracker_window_cap_disables():
+    """An idle peer pins the prefix trim; the tracker disarms at the
+    window bound instead of growing one entry per collective forever."""
+    t = prog.ProgramTracker(2, window=10)
+    for i in range(12):
+        assert t.feed(_req(0, f"op.{i}")) is None
+    assert t._disabled
+    assert all(len(s) == 0 for s in t._streams)
+    assert t.feed(_req(1, "late")) is None  # no comparisons once disarmed
+
+
+def test_coordinator_program_check_emits_error_response(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_VERIFY_PROGRAM", "1")
+    from horovod_tpu.ops.coordinator import Coordinator
+
+    coord = Coordinator(size=2, fusion_threshold=1 << 20)
+    coord.submit(_req(0, "a"))
+    coord.submit(_req(1, "b"))
+    resps = coord.poll_responses({})
+    errs = [r for r in resps
+            if r.response_type == wire.ResponseType.ERROR]
+    assert errs, resps
+    assert "Mismatched tensor names" in errs[0].error_message
+    coord.close()
+
+
+def test_verify_program_single_process(hvd2):
+    import jax.numpy as jnp
+
+    prog.recorder().clear()
+    hvd2.allreduce(jnp.ones((3,)), average=False, name="vp.op")
+    rep = hvd2.verify_program()
+    assert rep.ranks == 1
+    assert rep.entries == 1
+    assert len(rep.digest) == 64
+    # reset=True cleared the recorder for the next phase.
+    assert prog.recorder().total() == 0
+
+
+def test_recorder_captures_signature_fields(hvd2):
+    import jax.numpy as jnp
+
+    prog.recorder().clear()
+    hvd2.allreduce(jnp.ones((4,), jnp.float32), average=False,
+                   name="cap.op")
+    entries = prog.recorder().entries()
+    assert len(entries) == 1
+    e = entries[0]
+    assert (e.op, e.name, e.dtype, e.process_set_id) == (
+        "allreduce", "cap.op", "float32", 0)
+    assert e.reduce_op == wire.reduce_op_name(wire.ReduceOp.SUM)
+    prog.recorder().clear()
+
+
+def test_collective_source_tagging(hvd2):
+    import jax.numpy as jnp
+
+    prog.recorder().clear()
+    with prog.collective_source("torch"):
+        hvd2.allreduce(jnp.ones((2,)), average=False, name="tag.op")
+    assert prog.recorder().entries()[0].source == "torch"
+    prog.recorder().clear()
+
+
+# ---------------------------------------------------------------------------
+# lockorder
+# ---------------------------------------------------------------------------
+
+def test_lock_inversion_raises():
+    a = lockorder.CheckedLock("inv.A")
+    b = lockorder.CheckedLock("inv.B")
+
+    def establish():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=establish)
+    t.start()
+    t.join()
+    with b:
+        with pytest.raises(lockorder.LockOrderError) as ei:
+            a.acquire()
+    assert "inv.A" in str(ei.value) and "inv.B" in str(ei.value)
+    assert "inversion" in str(ei.value)
+
+
+def test_consistent_order_is_fine():
+    a = lockorder.CheckedLock("ok.A")
+    b = lockorder.CheckedLock("ok.B")
+
+    def use():
+        with a:
+            with b:
+                pass
+
+    threads = [threading.Thread(target=use) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with a:
+        with b:
+            pass  # same order everywhere: no cycle, no raise
+
+
+def test_rlock_reentrancy_is_not_an_inversion():
+    r = lockorder.CheckedRLock("re.R")
+    other = lockorder.CheckedLock("re.other")
+    with r:
+        with other:
+            with r:  # reentrant acquisition adds no reverse edge
+                pass
+
+
+def test_three_lock_cycle_detected():
+    a = lockorder.CheckedLock("tri.A")
+    b = lockorder.CheckedLock("tri.B")
+    c = lockorder.CheckedLock("tri.C")
+
+    def order(x, y):
+        with x:
+            with y:
+                pass
+
+    for x, y in ((a, b), (b, c)):
+        t = threading.Thread(target=order, args=(x, y))
+        t.start()
+        t.join()
+    with c:
+        with pytest.raises(lockorder.LockOrderError):
+            a.acquire()
+
+
+def test_factories_honor_env(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_LOCK_CHECK", "1")
+    assert isinstance(lockorder.make_lock("env.t"),
+                      lockorder.CheckedLock)
+    assert isinstance(lockorder.make_rlock("env.tr"),
+                      lockorder.CheckedRLock)
+    monkeypatch.setenv("HVD_TPU_LOCK_CHECK", "0")
+    assert isinstance(lockorder.make_lock("env.t2"), type(threading.Lock()))
+
+
+def test_trylock_failure_does_not_corrupt_stack():
+    a = lockorder.CheckedLock("try.A")
+    a.acquire()
+
+    def contend():
+        assert a.acquire(blocking=False) is False
+
+    t = threading.Thread(target=contend)
+    t.start()
+    t.join()
+    a.release()
+    # The failed try-acquire released its bookkeeping: reacquire works.
+    with a:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_strict_exit_codes(tmp_path, capsys):
+    from horovod_tpu.analysis import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                time.sleep(1)
+    """))
+    assert main([str(bad)]) == 0          # advisory without --strict
+    assert main(["--strict", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "blocking-under-lock" in out
+    assert main(["--strict", os.path.join(PKG, "analysis")]) == 0

@@ -78,6 +78,24 @@ def test_two_process_scenarios_combined(tmp_path):
 
 
 @pytest.mark.slow
+def test_verify_program_divergence_diagnostics():
+    """hvd-analyze pass 1 across REAL processes: a matching collective
+    program verifies clean over the TCP control plane, and every
+    divergence kind — dtype, shape, order, count, process-set deadlock
+    cycle — fails at verify time (before any data-plane work) with a
+    diagnostic naming the first divergent entry and both ranks'
+    records.  One launch covers all cases (tests/mp_worker.py
+    scenario_verify)."""
+    out = _launch("verify", timeout=300.0)
+    for rank in (0, 1):
+        assert f"VERIFY_OK rank={rank}" in out, out
+        for case in ("dtype", "shape", "order", "count", "cycle"):
+            assert f"VERIFY_DIVERGE_OK rank={rank} case={case}" in out, \
+                (case, out)
+        assert f"VERIFY_ALL_OK rank={rank}" in out, out
+
+
+@pytest.mark.slow
 def test_two_process_shutdown_poisons_peer_pending_op():
     out = _launch("shutdown")
     assert "SHUTDOWN_OK rank=0" in out

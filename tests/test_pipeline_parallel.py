@@ -2,6 +2,7 @@
 sequential layer application, forward and backward."""
 
 import jax
+from horovod_tpu.core import compat as _compat
 import jax.numpy as jnp
 import pytest
 from jax.sharding import PartitionSpec as P
@@ -44,7 +45,7 @@ def test_gpipe_matches_sequential(n_stages, n_micro):
         mine = select_stage_params(params)
         return gpipe(_stage_fn, mine, x, num_microbatches=n_micro)
 
-    got = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(P(), P()),
+    got = jax.jit(_compat.shard_map(run, mesh=mesh, in_specs=(P(), P()),
                                 out_specs=P(), check_vma=False))(params, x)
     want = _sequential(params, x)
     assert jnp.max(jnp.abs(got - want)) < TOL
@@ -57,7 +58,7 @@ def test_gpipe_gradients_match_sequential():
     params = _stacked_params(n_stages, d, seed=2)
     x = jax.random.normal(jax.random.PRNGKey(3), (8, d))
 
-    sm = jax.shard_map(
+    sm = _compat.shard_map(
         lambda params, x: gpipe(_stage_fn, select_stage_params(params), x,
                                 num_microbatches=n_micro),
         mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False)
@@ -71,7 +72,7 @@ def test_gpipe_rejects_indivisible_microbatches():
     mesh = make_mesh(pipe=2, devices=jax.devices()[:2])
     params = _stacked_params(2, 4)
     x = jnp.zeros((6, 4))
-    sm = jax.shard_map(
+    sm = _compat.shard_map(
         lambda params, x: gpipe(_stage_fn, select_stage_params(params), x,
                                 num_microbatches=4),
         mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False)
@@ -81,7 +82,7 @@ def test_gpipe_rejects_indivisible_microbatches():
 
 def test_stage_index():
     mesh = make_mesh(pipe=4, devices=jax.devices()[:4])
-    out = jax.jit(jax.shard_map(lambda: stage_index()[None], mesh=mesh,
+    out = jax.jit(_compat.shard_map(lambda: stage_index()[None], mesh=mesh,
                                 in_specs=(), out_specs=P(PIPE_AXIS),
                                 check_vma=False))()
     assert list(out) == [0, 1, 2, 3]
@@ -97,7 +98,7 @@ def test_gpipe_composes_with_data_parallel():
         mine = select_stage_params(params)
         return gpipe(_stage_fn, mine, x, num_microbatches=2)
 
-    got = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(P(), P("data")),
+    got = jax.jit(_compat.shard_map(run, mesh=mesh, in_specs=(P(), P("data")),
                                 out_specs=P("data"),
                                 check_vma=False))(params, x)
     want = _sequential(params, x)
