@@ -39,9 +39,20 @@ also runs an eager-path smoke on the real chip
 (allreduce/allgather/broadcast + a torch-frontend in-place round trip)
 and attaches ``eager_tpu_smoke`` to the JSON.
 
+Control-plane microbenchmark (round 6): ``--mode control`` measures
+negotiations/sec through the real coordinator facade + response cache
+(ops/cache.py) for a 64-tensor synthetic program, cache off vs on —
+pure host-side control plane, no XLA and no TPU tunnel, so this number
+exists even in rounds where the tunnel takes the headline metric down
+(BENCH_r01–r05 all recorded null for exactly that reason).  The default
+TPU run attaches the same measurement as ``control_plane`` in its JSON,
+success or failure, and ``--check-speedup X`` makes the control mode
+exit nonzero when cache-on/cache-off < X (the CI gate).
+
 Usage:
-  python bench.py            # full run (real TPU; batch 128, ~2 min)
-  python bench.py --smoke    # tiny shapes (CPU-friendly sanity check)
+  python bench.py                 # full run (real TPU; batch 128, ~2 min)
+  python bench.py --smoke         # tiny shapes (CPU-friendly sanity check)
+  python bench.py --mode control  # control-plane negotiations/sec only
 """
 
 from __future__ import annotations
@@ -179,6 +190,120 @@ def run(batch_size: int, image_size: int, warmup: int, iters: int,
     return result
 
 
+def _control_bench(tensors: int = 64, ranks: int = 4,
+                   seconds: float = 1.0) -> dict:
+    """Negotiations/sec through the real control plane, cache off vs on.
+
+    Models the rank-0 controller's steady-state tick for a 64-tensor
+    program (the multi-process hot path of ops/collective._drain +
+    ops/transport._handle_request_batch): rank 0's own requests go
+    through the Coordinator facade; the workers' arrivals are, cache
+    OFF, wire-parsed full requests fed to submit (table accumulation +
+    validation + response construction + fusion planning) and, cache
+    ON, decoded bit-vector hits fed to ``hit_from_wire`` followed by
+    the memoized-plan replay — exactly what each tick costs on the
+    production code path.
+    """
+    from horovod_tpu.ops import cache as hvd_cache
+    from horovod_tpu.ops import wire
+    from horovod_tpu.ops.coordinator import Coordinator
+
+    threshold = 64 << 20
+
+    def request_of(t: int, r: int) -> "wire.Request":
+        return wire.Request(
+            request_rank=r, request_type=wire.RequestType.ALLREDUCE,
+            tensor_type=wire.DataType.FLOAT32, tensor_name=f"grad.{t}",
+            tensor_shape=(1024,), reduce_op=wire.ReduceOp.SUM)
+
+    # The workers' frames as they sit in the receive buffer: packed wire
+    # bytes (parsing them is part of the cache-off cost, exactly as in
+    # transport._serve).
+    packed = [[request_of(t, r).pack() for r in range(1, ranks)]
+              for t in range(tensors)]
+
+    def drain(coord, cache) -> int:
+        resps = []
+        if cache is not None:
+            marker = cache.take_flush_marker()
+            if marker is not None:
+                resps.append(marker)
+            replayed, _g, _e, _c = cache.take_ready(lambda psid: threshold)
+            resps += replayed
+        resps += coord.poll_responses({})
+        if cache is not None:
+            for resp in resps:
+                cache.observe_response(resp)
+        return sum(len(r.tensor_names) for r in resps
+                   if r.response_type == wire.ResponseType.ALLREDUCE)
+
+    def measure(cache_on: bool):
+        cache = hvd_cache.ResponseCache(rank=0) if cache_on else None
+        coord = Coordinator(size=ranks, fusion_threshold=threshold,
+                            cache=cache)
+
+        # Warmup cycle = the first (cold) negotiation; populates the
+        # cache and yields the entry indices the workers' bits name.
+        for t in range(tensors):
+            coord.submit(request_of(t, 0))
+            for buf in packed[t]:
+                req, _ = wire.Request.unpack(buf)
+                coord.submit(req)
+        n = drain(coord, cache)
+        assert n == tensors, (n, tensors)
+        idxs = None
+        if cache is not None:
+            idxs = [cache.entry_index(f"grad.{t}") for t in range(tensors)]
+            assert all(i is not None for i in idxs), idxs
+            epoch = cache.epoch
+
+        def one_cycle() -> int:
+            if cache is None:
+                for t in range(tensors):
+                    coord.submit(request_of(t, 0))
+                    for buf in packed[t]:
+                        req, _ = wire.Request.unpack(buf)
+                        coord.submit(req)
+            else:
+                for t in range(tensors):
+                    coord.submit(request_of(t, 0))
+                    for r in range(1, ranks):
+                        down = cache.hit_from_wire(idxs[t], r, epoch)
+                        assert down is None, down
+            return drain(coord, cache)
+
+        done = 0
+        cycles = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            got = one_cycle()
+            assert got == tensors, (got, tensors)
+            done += got
+            cycles += 1
+        dt = time.perf_counter() - t0
+        if cache is not None:
+            s = cache.stats
+            assert s.replayed_tensors >= done, \
+                ("cache-on run must serve from replay", s)
+        coord.close()
+        return done / dt, cycles
+
+    off_rate, off_cycles = measure(cache_on=False)
+    on_rate, on_cycles = measure(cache_on=True)
+    return {
+        "metric": "control_plane_negotiations_per_sec",
+        "value": round(on_rate, 1),
+        "unit": "negotiations/sec",
+        "cache_on": round(on_rate, 1),
+        "cache_off": round(off_rate, 1),
+        "speedup": round(on_rate / off_rate, 2) if off_rate else None,
+        "vs_baseline": round(on_rate / off_rate, 2) if off_rate else None,
+        "tensors": tensors,
+        "ranks": ranks,
+        "cycles": {"cache_on": on_cycles, "cache_off": off_cycles},
+    }
+
+
 def _probe_inner() -> int:
     """Tunnel probe child: one tiny jitted matmul with a host fetch.
 
@@ -242,6 +367,15 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for CPU sanity checks")
+    ap.add_argument("--mode", choices=["resnet", "control"],
+                    default="resnet",
+                    help="control = control-plane negotiations/sec only "
+                         "(no XLA, no TPU tunnel)")
+    ap.add_argument("--check-speedup", type=float, default=None,
+                    help="control mode: exit nonzero when the cache-on/"
+                         "cache-off speedup is below this bound (CI gate)")
+    ap.add_argument("--control-seconds", type=float, default=1.0,
+                    help="control mode: seconds per measurement leg")
     ap.add_argument("--batch-size", type=int, default=128)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--iters", type=int, default=20)
@@ -268,6 +402,18 @@ def main() -> int:
     ap.add_argument("--_eager_smoke", action="store_true",
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.mode == "control":
+        result = _control_bench(seconds=args.control_seconds)
+        print(json.dumps(result))
+        if args.check_speedup is not None:
+            speedup = result.get("speedup") or 0.0
+            if speedup < args.check_speedup:
+                print(f"FAIL: response-cache speedup {speedup}x is below "
+                      f"the required {args.check_speedup}x",
+                      file=sys.stderr)
+                return 1
+        return 0
 
     if args._probe:
         return _probe_inner()
@@ -356,8 +502,20 @@ def _run_child(extra_args, timeout):
     return rc, payload, timed_out
 
 
-def _fail_json(error: str, attempts: int, attempt_log=None) -> int:
-    """Persistent failure: one parseable JSON line, not a traceback."""
+def _control_or_error() -> dict:
+    """The control-plane microbench for the supervised run's JSON —
+    tunnel-immune, so it must never take the whole bench down either."""
+    try:
+        return _control_bench(seconds=0.5)
+    except Exception as e:  # noqa: BLE001 — structured either way
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _fail_json(error: str, attempts: int, attempt_log=None,
+               control=None) -> int:
+    """Persistent failure: one parseable JSON line, not a traceback.
+    The control-plane number still rides along — it cannot be taken
+    down by the tunnel, so every round records at least that."""
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
         "value": None,
@@ -366,6 +524,8 @@ def _fail_json(error: str, attempts: int, attempt_log=None) -> int:
         "error": error,
         "attempts": attempts,
         "attempt_log": attempt_log or [],
+        "control_plane": control if control is not None
+        else _control_or_error(),
     }))
     return 1
 
@@ -394,6 +554,9 @@ def _supervise(args) -> int:
     deadline = time.monotonic() + args.total_budget
     t_start = time.monotonic()
     attempt_log = []
+    # Control-plane microbench first: host-only, ~1 s, tunnel-immune —
+    # whatever happens to the TPU below, this round records it.
+    control = _control_or_error()
 
     def remaining() -> float:
         return deadline - time.monotonic()
@@ -452,7 +615,7 @@ def _supervise(args) -> int:
         return _fail_json(
             f"tunnel probe failed {probe_n}x over "
             f"{time.monotonic() - t_start:.0f}s (TPU tunnel down/hung?)",
-            attempts=0, attempt_log=attempt_log)
+            attempts=0, attempt_log=attempt_log, control=control)
 
     # Phase 1 — measurement attempts, each clamped to remaining budget.
     last_err = "unknown"
@@ -492,7 +655,7 @@ def _supervise(args) -> int:
                            max(0.0, remaining() - _MIN_ATTEMPT)))
     if payload is None:
         return _fail_json(last_err, attempts=attempts_made,
-                          attempt_log=attempt_log)
+                          attempt_log=attempt_log, control=control)
 
     # Phase 2 — eager/dynamic-path smoke on the real chip (budget
     # permitting).  Failure is reported, not fatal: the headline number
@@ -510,6 +673,7 @@ def _supervise(args) -> int:
             payload["eager_tpu_smoke"] = f"failed rc={rc}: {smoke}"
     else:
         payload["eager_tpu_smoke"] = "skipped: budget exhausted"
+    payload["control_plane"] = control
     payload["attempt_log"] = attempt_log
     print(json.dumps(payload))
     return 0

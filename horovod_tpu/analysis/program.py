@@ -242,6 +242,15 @@ def _digest(entries: List[SignatureEntry], total: int) -> str:
     return h.hexdigest()
 
 
+def entries_digest(entries: List[SignatureEntry],
+                   total: Optional[int] = None) -> str:
+    """Public digest over a list of signature entries — the canonical
+    program-identity scheme shared by verify_program's exchange and the
+    response cache's cycle keys (ops/cache.py): equal digests ⇔
+    identical programs under the same encoding everywhere."""
+    return _digest(entries, len(entries) if total is None else total)
+
+
 def pack_program(rank: int, entries: List[SignatureEntry],
                  total: int) -> bytes:
     return json.dumps({

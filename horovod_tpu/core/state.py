@@ -108,6 +108,11 @@ class _GlobalState:
     next_process_set_id: int = 1
     # Timeline (utils.timeline.Timeline) when HOROVOD_TIMELINE is set.
     timeline: Any = None
+    # Steady-state negotiation response cache (ops.cache.ResponseCache);
+    # one replica per rank, shared by the coordinator facades and the
+    # transport.  None when HVD_TPU_RESPONSE_CACHE=0 or the program
+    # tracker is armed (they are mutually exclusive — see cache_enabled).
+    response_cache: Any = None
     # Native coordinator handle (ops.coordinator.Coordinator).
     coordinator: Any = None
     # Handle manager for the async API (ops.handles.HandleManager).
@@ -211,7 +216,12 @@ def init(devices=None) -> None:
 
         _state.handle_manager = HandleManager()
 
+        from ..ops import cache as _cache
         from ..ops.coordinator import Coordinator
+
+        _state.response_cache = (
+            _cache.ResponseCache(rank=_state.process_index)
+            if _cache.cache_enabled() else None)
 
         if _state.multiprocess:
             # Reference topology: negotiation runs at process (MPI-rank)
@@ -229,6 +239,7 @@ def init(devices=None) -> None:
                     size=_state.process_count,
                     fusion_threshold=_state.fusion_threshold_bytes,
                     timeline=_state.timeline,
+                    cache=_state.response_cache,
                 )
                 _state.transport = _transport.ControllerTransport(
                     _state.coordinator, _state.process_count,
@@ -240,11 +251,19 @@ def init(devices=None) -> None:
                     spec.controller_host, spec.controller_port,
                     _state.process_index)
                 _state.topology = _state.transport.topology
+                if not _state.transport.controller_cache:
+                    # Rank 0 advertised no response cache (its env
+                    # disables it, or its program tracker is armed): a
+                    # local replica would emit bits rank 0 can never
+                    # resolve — run cache-less instead.
+                    _state.response_cache = None
+            _state.transport.cache = _state.response_cache
         else:
             _state.coordinator = Coordinator(
                 size=_state.size,
                 fusion_threshold=_state.fusion_threshold_bytes,
                 timeline=_state.timeline,
+                cache=_state.response_cache,
             )
 
         # Autotune (HOROVOD_AUTOTUNE=1, post-v0.13 subsystem): explore
@@ -353,6 +372,7 @@ def shutdown() -> None:
         if _state.coordinator is not None:
             _state.coordinator.close()
             _state.coordinator = None
+        _state.response_cache = None
         _state.topology = None
         _state.multiprocess = False
         _state.shutdown = True

@@ -6,6 +6,15 @@
 // :1328-1374). The reference keeps this machinery in C++ because it sits on
 // the latency floor of every collective; ours does the same for the dynamic
 // (eager) path while the static pjit path bypasses it entirely.
+//
+// The steady-state response cache (ops/cache.py) deliberately layers ABOVE
+// this implementation, in the Python Coordinator facade: a cache hit skips
+// hvd_coord_submit / response construction here entirely, so both the
+// native and the Python twin profit identically and the wire parity
+// contract (fuzzed in tests/test_coordinator.py) stays about negotiation
+// alone. The submit-time nbytes bookkeeping added to the Python twin's
+// _PendingTensor mirrors kPayloadBytes accounting here: both resolve a
+// response's fusion size once, never per drain tick.
 
 #include <algorithm>
 #include <chrono>

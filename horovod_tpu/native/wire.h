@@ -28,10 +28,16 @@ int DataTypeSize(DataType t);  // bytes per element (≙ wire.dtype_size)
 enum class RequestType : uint8_t { kAllreduce = 0, kAllgather = 1,
                                    kBroadcast = 2, kJoin = 3,
                                    kReducescatter = 4, kAlltoall = 5 };
+// kCacheFlush is the response-cache epoch marker (ops/cache.py): the
+// cache itself layers ABOVE both coordinator implementations in the
+// Python facade (ops/coordinator.py Coordinator), so the native
+// coordinator never produces or consumes it — the value is mirrored
+// here only to keep the wire enum spaces identical.
 enum class ResponseType : uint8_t { kAllreduce = 0, kAllgather = 1,
                                     kBroadcast = 2, kError = 3, kDone = 4,
                                     kShutdown = 5, kJoin = 6,
-                                    kReducescatter = 7, kAlltoall = 8 };
+                                    kReducescatter = 7, kAlltoall = 8,
+                                    kCacheFlush = 9 };
 
 // Allreduce reduction operator (post-v0.13 Horovod op= API; the v0.13
 // reference hard-codes MPI_SUM).  ≙ ops/wire.py ReduceOp.

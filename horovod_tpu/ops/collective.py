@@ -109,6 +109,11 @@ def _initiate_shutdown(message: str = SHUT_DOWN_ERROR_MESSAGE) -> None:
     controller's drain loop (≙ operations.cc:1377-1403)."""
     st = _state.global_state()
     st.peer_shutdown = True
+    if st.response_cache is not None:
+        # Dead-peer / shutdown poisoning: cached cycles must never
+        # replay across the teardown; orphans are dropped — everything
+        # pending is about to be poisoned below anyway.
+        st.response_cache.flush("shutdown")
     if (st.multiprocess and st.transport is not None
             and st.process_index == 0):
         st.transport.broadcast_responses(
@@ -649,6 +654,13 @@ class _QueuedOp:
     handle: int
     nbytes: int
     ps: Any = None  # ProcessSet for non-global ops
+    # This rank's wire Request (multi-process; rank 0's in
+    # single-process), retained so the response cache can store the
+    # exact negotiated request at insertion time (ops/cache.py).
+    request: Any = None
+    # True when negotiation was served from the response cache — rides
+    # the timeline EXECUTE span so cache wins are visible per tensor.
+    cache_hit: bool = False
 
 
 class _OpQueue:
@@ -724,7 +736,11 @@ def _background_loop(stop_event: threading.Event) -> None:
 def _submit_requests(name: str, op: RequestType, c: _Contribution,
                      root_rank: int = -1,
                      red_op: ReduceOp = ReduceOp.SUM, ps=None,
-                     splits: Tuple[int, ...] = ()) -> None:
+                     splits: Tuple[int, ...] = (),
+                     queued_op: Optional[_QueuedOp] = None) -> bool:
+    """Submit the negotiation request(s) for one collective; returns
+    True when negotiation was served from the response cache (the
+    steady-state fast path, ops/cache.py)."""
     st = _state.global_state()
     psid = 0 if ps is None else ps.process_set_id
     if st.timeline is not None:
@@ -735,21 +751,42 @@ def _submit_requests(name: str, op: RequestType, c: _Contribution,
         # coordinator (≙ the MPI_Gatherv of MPIRequests,
         # operations.cc:1240-1288).  Set requests carry SET-LOCAL ranks.
         rank = st.process_index if ps is None else ps.rank()
-        st.transport.submit(Request(
+        req = Request(
             request_rank=rank, request_type=op,
             tensor_type=wire.dtype_of(c.dtype), tensor_name=name,
             root_rank=root_rank, device=c.devices[0],
             tensor_shape=c.shapes[0], reduce_op=red_op,
-            process_set_id=psid, splits=splits))
-        return
+            process_set_id=psid, splits=splits)
+        if queued_op is not None:
+            # Set BEFORE the send: once the request is on the wire a
+            # response may arrive any time, and the cache insertion
+            # reads it from the queued op.
+            queued_op.request = req
+        return bool(st.transport.submit(req))
     coord = st.coordinator if ps is None else ps.coordinator
+    hit_any = False
     for r in range(st.size if ps is None else ps.size()):
-        coord.submit(Request(
+        req = Request(
             request_rank=r, request_type=op,
             tensor_type=wire.dtype_of(c.dtype), tensor_name=name,
             root_rank=root_rank, device=c.devices[r],
             tensor_shape=c.shapes[r], reduce_op=red_op,
-            process_set_id=psid, splits=splits))
+            process_set_id=psid, splits=splits)
+        if queued_op is not None and r == 0:
+            queued_op.request = req
+        _, hit = coord.submit_ex(req)
+        hit_any = hit_any or hit
+    return hit_any
+
+
+def _tl_start(tl, o: _QueuedOp, op_name: str) -> None:
+    """Open the tensor's top-level EXECUTE-phase span, tagged with
+    whether its negotiation was served from the response cache (the
+    NEGOTIATE span carries phase=NEGOTIATE symmetrically, so cache wins
+    are visible per tensor in the Chrome trace)."""
+    tl.start(o.name, op_name,
+             args={"phase": "EXECUTE",
+                   "cache": "hit" if o.cache_hit else "miss"})
 
 
 def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
@@ -764,6 +801,9 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
     st = _state.global_state()
     tl = st.timeline
     hm = st.handle_manager
+
+    if resp.response_type == ResponseType.CACHE_FLUSH:
+        return  # response-cache epoch marker; handled by observe_response
 
     if resp.response_type == ResponseType.ERROR:
         err = HorovodError(resp.error_message)
@@ -816,7 +856,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
                         + ("_pr" if layout else "_rep")]
             if len(group) == 1:
                 o = group[0]
-                if tl: tl.start(o.name, "ALLREDUCE")
+                if tl: _tl_start(tl, o, "ALLREDUCE")
                 if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
                 out = kernel(o.contrib.value)
                 if o.red_op == ReduceOp.AVERAGE:
@@ -827,7 +867,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
                 continue
             # Fused path.
             for o in group:
-                if tl: tl.start(o.name, "ALLREDUCE")
+                if tl: _tl_start(tl, o, "ALLREDUCE")
                 if tl: tl.activity_start(o.name, "MEMCPY_IN_FUSION_BUFFER")
             if layout:
                 # per-replica: flatten payload per replica, concat axis 1.
@@ -882,7 +922,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
         pad_mask = jnp.asarray(m_idx[None, None, :] < matrix[:, :, None])
         for o in ops:
             c = o.contrib
-            if tl: tl.start(o.name, "ALLTOALL")
+            if tl: _tl_start(tl, o, "ALLTOALL")
             if tl: tl.activity_start(o.name, "XLA_ALLTOALL")
             rest = tuple(c.shapes[0][1:])
             x = jnp.asarray(c.value)
@@ -922,7 +962,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
     if resp.response_type == ResponseType.REDUCESCATTER:
         ks = _mesh_kernels() if ps is None else ps.mesh_and_kernels()[1]
         for o in ops:  # never fused: each op owns its chunk layout
-            if tl: tl.start(o.name, "REDUCESCATTER")
+            if tl: _tl_start(tl, o, "REDUCESCATTER")
             if tl: tl.activity_start(o.name, "XLA_REDUCESCATTER")
             kernel = ks["rscatter_pr" if o.contrib.per_replica
                         else "rscatter_rep"]
@@ -938,7 +978,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
         ks = _mesh_kernels() if ps is None else ps.mesh_and_kernels()[1]
         for o in ops:
             c = o.contrib
-            if tl: tl.start(o.name, "ALLGATHER")
+            if tl: _tl_start(tl, o, "ALLGATHER")
             if tl: tl.activity_start(o.name, "XLA_ALLGATHER")
             if c.ragged or isinstance(c.value, list):
                 sizes = resp.tensor_sizes or c.orig_sizes
@@ -976,7 +1016,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
         ks = _mesh_kernels() if ps is None else ps.mesh_and_kernels()[1]
         for o in ops:
             c = o.contrib
-            if tl: tl.start(o.name, "BROADCAST")
+            if tl: _tl_start(tl, o, "BROADCAST")
             if tl: tl.activity_start(o.name, "XLA_BCAST")
             if c.per_replica:
                 out = ks["bcast_pr"](c.value, jnp.int32(o.root_rank))
@@ -1043,7 +1083,7 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
     if resp.response_type == ResponseType.ALLREDUCE:
         if len(ops) == 1:
             o = ops[0]
-            if tl: tl.start(o.name, "ALLREDUCE")
+            if tl: _tl_start(tl, o, "ALLREDUCE")
             if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
             out = ks[_OP_KERNEL[o.red_op] + "_out_rep"](
                 _mp_global(o.contrib.value, ps))
@@ -1057,7 +1097,7 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
         # Homogeneous in red_op — the coordinator fuses like-op only (and
         # never fuses adasum, whose dots are per-tensor).
         for o in ops:
-            if tl: tl.start(o.name, "ALLREDUCE")
+            if tl: _tl_start(tl, o, "ALLREDUCE")
             if tl: tl.activity_start(o.name, "MEMCPY_IN_FUSION_BUFFER")
         buf = jnp.concatenate([jnp.ravel(o.contrib.value) for o in ops])
         for o in ops:
@@ -1088,7 +1128,7 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
         M = int(matrix.max()) if matrix.size else 0
         for o in ops:
             c = o.contrib
-            if tl: tl.start(o.name, "ALLTOALL")
+            if tl: _tl_start(tl, o, "ALLTOALL")
             if tl: tl.activity_start(o.name, "XLA_ALLTOALL")
             rest = tuple(c.shapes[0][1:])
             local = np.asarray(c.value)
@@ -1110,7 +1150,7 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
 
     if resp.response_type == ResponseType.REDUCESCATTER:
         for o in ops:
-            if tl: tl.start(o.name, "REDUCESCATTER")
+            if tl: _tl_start(tl, o, "REDUCESCATTER")
             if tl: tl.activity_start(o.name, "XLA_REDUCESCATTER")
             res = ks["rscatter_pr"](_mp_global(o.contrib.value, ps))
             # This process's chunk: its addressable row of the P(A)
@@ -1127,7 +1167,7 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
     if resp.response_type == ResponseType.ALLGATHER:
         for o in ops:
             c = o.contrib
-            if tl: tl.start(o.name, "ALLGATHER")
+            if tl: _tl_start(tl, o, "ALLGATHER")
             if tl: tl.activity_start(o.name, "XLA_ALLGATHER")
             # The coordinator's response carries every rank's dim-0 extent
             # (≙ MPIResponse.tensor_sizes, mpi_message.h:48-51).
@@ -1153,7 +1193,7 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
     if resp.response_type == ResponseType.BROADCAST:
         for o in ops:
             c = o.contrib
-            if tl: tl.start(o.name, "BROADCAST")
+            if tl: _tl_start(tl, o, "BROADCAST")
             if tl: tl.activity_start(o.name, "XLA_BCAST")
             out = ks["bcast_pr"](_mp_global(c.value, ps),
                                  jnp.int32(o.root_rank))
@@ -1270,6 +1310,83 @@ def join() -> int:
     return st.join_result
 
 
+def _threshold_snapshot(st):
+    """psid -> fusion threshold of the owning coordinator, snapshotted
+    BEFORE entering the cache (ResponseCache._lock is a leaf lock; the
+    take_ready callback must therefore be pure — resolving process sets
+    from inside it would acquire st.lock under the cache lock).  The
+    replay plan uses the same packing budget the live negotiation
+    would; a psid not in the snapshot (set removed this tick — its
+    entries are flushed anyway) falls back to the global threshold."""
+    default = (st.coordinator.fusion_threshold
+               if st.coordinator is not None
+               else st.fusion_threshold_bytes)
+    thresholds = {0: default}
+    for set_ps in _state.process_sets_snapshot():
+        if set_ps.coordinator is not None:
+            thresholds[set_ps.process_set_id] = \
+                set_ps.coordinator.fusion_threshold
+    return lambda psid: thresholds.get(psid, default)
+
+
+def _resubmit_orphans(st, orphans) -> None:
+    """Route cached submissions downgraded by a flush back into the real
+    negotiation path (each carries its process-set id)."""
+    for req in orphans:
+        coord = st.coordinator if req.process_set_id == 0 else None
+        if coord is None:
+            ps = _state.get_process_set(req.process_set_id)
+            coord = None if ps is None else ps.coordinator
+        if coord is None:
+            continue  # set removed meanwhile; submitter times out/report
+        try:
+            coord.submit(req)
+        except ValueError:
+            pass  # duplicate: the rank re-submitted meanwhile
+
+
+def _coordinator_tick(st):
+    """One rank-0 (or single-process) negotiation tick: cache replay +
+    flush markers + freshly negotiated responses, in the stream order
+    every replica relies on.  Returns (responses, replay groups, epoch,
+    compact_ok, n_non_replay, replay_ids) — the groups let the
+    transport broadcast a pure-replay cycle compactly, and replay_ids
+    identifies the replayed responses so observation never re-inserts
+    them (the worker-side equivalent is the name-presence check)."""
+    cache = st.response_cache
+    meta = _queue.pending_meta()
+    marker = None
+    replayed: List[Response] = []
+    groups: List[List[int]] = []
+    epoch = 0
+    compact = True
+    if cache is not None:
+        _resubmit_orphans(st, cache.check_capacity())
+        marker = cache.take_flush_marker()
+        replayed, groups, epoch, compact = cache.take_ready(
+            _threshold_snapshot(st))
+        if replayed and st.timeline is not None:
+            # The one NEGOTIATE-span closer for cache-served tensors:
+            # submit-side hits deliberately leave the span open (a
+            # remote bit may be the completing hit, which submit never
+            # sees), and this runs exactly once per replayed tensor.
+            for r in replayed:
+                for n in r.tensor_names:
+                    st.timeline.negotiate_end(n)
+    negotiated = st.coordinator.poll_responses(meta)
+    for set_ps in _state.process_sets_snapshot():
+        if set_ps.coordinator is not None:
+            negotiated += set_ps.coordinator.poll_responses(meta)
+    # Marker FIRST: replicas must flush before inserting anything this
+    # tick's negotiations produce; replayed responses reference live
+    # (post-flush) entries whenever a marker is present, so the order
+    # [marker, replays, negotiated] is safe in every interleaving.
+    resps = ([marker] if marker is not None else []) + replayed + negotiated
+    return resps, groups, epoch, compact, \
+        (1 if marker is not None else 0) + len(negotiated), \
+        frozenset(id(r) for r in replayed)
+
+
 def _drain() -> None:
     """Poll the coordinator and execute every ready (fused) response
     (≙ one background-loop tick, operations.cc:1219-1374).  Validation
@@ -1278,6 +1395,7 @@ def _drain() -> None:
     (operations.cc:1060-1067)."""
     st = _state.global_state()
     with _drain_lock:
+        cache = st.response_cache
         if st.multiprocess:
             tp = st.transport
             if tp is None:
@@ -1298,15 +1416,20 @@ def _drain() -> None:
                 # worker, then execute locally in the same order
                 # (≙ MPI_Bcast of the response list, operations.cc:1290).
                 tp.flush_unrouted()  # set requests that beat registration
-                meta = _queue.pending_meta()
-                resps = st.coordinator.poll_responses(meta)
-                for set_ps in _state.process_sets_snapshot():
-                    if set_ps.coordinator is not None:
-                        resps += set_ps.coordinator.poll_responses(meta)
+                resps, groups, epoch, compact, n_other, replay_ids = \
+                    _coordinator_tick(st)
                 if resps:
-                    tp.broadcast_responses(resps)
+                    if compact and groups and n_other == 0:
+                        # Pure cache replay: the steady-state frame —
+                        # entry-index groups instead of full payloads.
+                        tp.broadcast_replay(groups, epoch)
+                    else:
+                        tp.broadcast_responses(resps)
                 for resp in resps:
                     ops = _queue.take(resp.tensor_names)
+                    if cache is not None:
+                        cache.observe_response(
+                            resp, replay=id(resp) in replay_ids)
                     _execute_response(resp, ops)
                     if st.autotuner is not None:
                         st.autotuner.record_bytes(
@@ -1314,21 +1437,27 @@ def _drain() -> None:
                 if st.autotuner is not None:
                     st.autotuner.maybe_step()
             else:
+                tp.flush_requests()  # the tick's coalesced control frame
                 while True:
                     resps = tp.poll_responses()
                     if resps is None:
                         break
                     for resp in resps:
-                        _execute_response(resp,
-                                          _queue.take(resp.tensor_names))
+                        ops = _queue.take(resp.tensor_names)
+                        if cache is not None:
+                            cache.observe_response(resp, own_requests={
+                                st.process_index: {
+                                    o.name: o.request for o in ops
+                                    if o.request is not None}})
+                        _execute_response(resp, ops)
             return
-        meta = _queue.pending_meta()
-        resps = st.coordinator.poll_responses(meta)
-        for set_ps in _state.process_sets_snapshot():
-            if set_ps.coordinator is not None:
-                resps += set_ps.coordinator.poll_responses(meta)
+        resps, _groups, _epoch, _compact, _n, replay_ids = \
+            _coordinator_tick(st)
         for resp in resps:
             ops = _queue.take(resp.tensor_names)
+            if cache is not None:
+                cache.observe_response(resp,
+                                       replay=id(resp) in replay_ids)
             _execute_response(resp, ops)
             if st.autotuner is not None:
                 st.autotuner.record_bytes(sum(o.nbytes for o in ops))
@@ -1420,13 +1549,17 @@ def _enqueue(x, op: RequestType, name: Optional[str],
         process_set_id=0 if process_set is None
         else process_set.process_set_id)
     handle = st.handle_manager.allocate(None, name=name)
-    _queue.put(_QueuedOp(name=name, op=op, contrib=c, red_op=red_op,
-                         root_rank=root_rank, handle=handle, nbytes=nbytes,
-                         ps=process_set))
+    qop = _QueuedOp(name=name, op=op, contrib=c, red_op=red_op,
+                    root_rank=root_rank, handle=handle, nbytes=nbytes,
+                    ps=process_set)
+    _queue.put(qop)
     # The execute paths read split info from the NEGOTIATED response
     # matrix, never from the local op — splits ride the request only.
-    _submit_requests(name, op, c, root_rank, red_op=red_op, ps=process_set,
-                     splits=tuple(splits))
+    hit = _submit_requests(name, op, c, root_rank, red_op=red_op,
+                           ps=process_set, splits=tuple(splits),
+                           queued_op=qop)
+    qop.cache_hit = hit
+    st.handle_manager._get(handle).cache_hit = hit
     return handle
 
 
@@ -1540,6 +1673,14 @@ def remove_process_set(process_set) -> bool:
         ps = st.process_sets.pop(psid, None)
     if ps is not None:
         ps.close()
+    if not st.multiprocess and st.response_cache is not None:
+        # Multi-process mode flushes deterministically when every rank
+        # observes the process_set.remove.* allgather in the response
+        # stream (ops/cache.py); single-process has no such collective,
+        # so flush directly — a cached cycle must never replay a
+        # response into a removed set.
+        _resubmit_orphans(st, st.response_cache.flush(
+            f"remove_process_set({psid})"))
     return True
 
 
@@ -1715,15 +1856,22 @@ def add_process_set(ranks):
     ps = ProcessSet(psid, ranks)
     # Per-set coordinator wherever negotiation happens: the rank-0
     # controller in multi-process mode, the in-process coordinator
-    # single-process.
+    # single-process.  It shares the one response-cache replica (entry
+    # indices span every set — insertion order is the broadcast stream)
+    # and carries the set's global-rank table for hit accounting.
     if st.coordinator is not None:
         from .coordinator import Coordinator
 
         ps.coordinator = Coordinator(
             size=ps.size(), fusion_threshold=st.fusion_threshold_bytes,
-            timeline=st.timeline)
+            timeline=st.timeline, cache=st.response_cache, ranks=ranks)
     with st.lock:
         st.process_sets[psid] = ps
+    if not st.multiprocess and st.response_cache is not None:
+        # Same rationale as remove_process_set: multi-process flushes on
+        # the registration allgather; single-process flushes here.
+        _resubmit_orphans(st, st.response_cache.flush(
+            f"add_process_set({psid})"))
     return ps
 
 

@@ -38,6 +38,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from . import cache as _cache
 from . import wire
 from .wire import (DataType, Request, RequestType, Response, ResponseType)
 from ..analysis import lockorder as _lockorder
@@ -56,6 +57,11 @@ class _PendingTensor:
     requests: List[Request] = field(default_factory=list)
     ranks: set = field(default_factory=set)
     first_seen: float = 0.0
+    # Payload bytes of one replica's tensor, computed ONCE at submit
+    # time from the first request's shape × dtype (the same formula the
+    # op queue uses) instead of re-derived for every pending response on
+    # every drain tick.
+    nbytes: int = 0
 
 
 def _withdraw_message(name: str, rank: int) -> str:
@@ -85,6 +91,10 @@ class PyCoordinator:
         # (the reference reads this from its TensorTable during the fusion
         # loop, operations.cc:1328-1374).
         self._resp_dtype: Dict[str, DataType] = {}  # guarded_by: _lock
+        # Submit-time payload bytes per constructed response: the fusion
+        # loop's fallback when the queue-side size table has no entry,
+        # carried from _PendingTensor so it is never recomputed per tick.
+        self._resp_nbytes: Dict[str, int] = {}  # guarded_by: _lock
         # ERROR responses queued by withdraw(); drained ahead of the ready
         # tensors by poll_responses.
         self._withdrawn: List[Response] = []  # guarded_by: _lock
@@ -141,6 +151,10 @@ class PyCoordinator:
             entry = self.table.get(req.tensor_name)
             if entry is None:
                 entry = _PendingTensor(first_seen=now)
+                n = 1
+                for d in req.tensor_shape:
+                    n *= int(d)
+                entry.nbytes = n * wire.dtype_size(req.tensor_type)
                 self.table[req.tensor_name] = entry
             if req.request_rank in entry.ranks:
                 raise ValueError(
@@ -349,6 +363,7 @@ class PyCoordinator:
             return Response(ResponseType.ERROR, [name], error_message=error,
                             process_set_id=first.process_set_id)
         self._resp_dtype[name] = first.tensor_type
+        self._resp_nbytes[name] = entry.nbytes
         devices = [r.device for r in reqs]
         # dtype + shape ride every data response so joined ranks can
         # build zero contributions (hvd.join); BROADCAST also carries
@@ -384,59 +399,38 @@ class PyCoordinator:
             release, self._join_release = self._join_release, []
             ready, self.ready = self.ready, []
             responses = [self._construct_response_locked(n) for n in ready]
-            # Snapshot for the fusion loop below: it runs outside the
-            # lock, and _resp_dtype is mutated by concurrent submits'
+            # Snapshots for the fusion planning below: it runs outside
+            # the lock, and both maps are mutated by concurrent submits'
             # construct_response (surfaced by the guarded-by lint pass).
             dtypes = dict(self._resp_dtype)
-        def nbytes_of(resp: Response) -> int:
-            # Prefer the queue-side size table; fall back to the
-            # shape × dtype the response itself carries (a process set
-            # excluding the controller has no entries in ITS queue, and
-            # an unbounded fallback of 0 would defeat the threshold).
-            got = sizes_bytes.get(resp.tensor_names[0])
-            if got is not None:
-                return got
-            shape = resp.tensor_shapes[0] if resp.tensor_shapes else ()
-            n = 1
-            for d in shape:
-                n *= int(d)
-            return n * wire.dtype_size(dtypes.get(
-                resp.tensor_names[0], DataType.FLOAT32))
+            nbytes_map = dict(self._resp_nbytes)
 
+        # Per-response payload bytes, resolved ONCE: the queue-side size
+        # table wins when present, else the submit-time value carried on
+        # the table entry (a process set excluding the controller has no
+        # entries in ITS queue, and an unbounded fallback of 0 would
+        # defeat the threshold).
+        metas = [_cache._FusionMeta(
+            response_type=r.response_type, devices=tuple(r.devices),
+            reduce_op=r.reduce_op, process_set_id=r.process_set_id,
+            dtype=dtypes.get(r.tensor_names[0]),
+            nbytes=sizes_bytes.get(r.tensor_names[0],
+                                   nbytes_map.get(r.tensor_names[0], 0)))
+            for r in responses]
         fused: List[Response] = list(withdrawn)
-        i = 0
-        while i < len(responses):
-            r = responses[i]
-            i += 1
-            if r.response_type != ResponseType.ALLREDUCE \
-                    or r.reduce_op == wire.ReduceOp.ADASUM:
-                # Adasum never fuses: its dot products are per-tensor
-                # scale adaptations, not elementwise reductions.
-                fused.append(r)
-                continue
-            total = nbytes_of(r)
-            dtype = dtypes.get(r.tensor_names[0])
-            j = i
-            while j < len(responses):
+        for group in _cache.plan_fusion(metas,
+                                        lambda _psid: self.fusion_threshold):
+            r = responses[group[0]]
+            for j in group[1:]:
                 nxt = responses[j]
-                if (nxt.response_type == ResponseType.ALLREDUCE
-                        and nxt.devices == r.devices
-                        and nxt.reduce_op == r.reduce_op
-                        and nxt.process_set_id == r.process_set_id
-                        and dtypes.get(nxt.tensor_names[0]) == dtype
-                        and total + nbytes_of(nxt)
-                        <= self.fusion_threshold):
-                    total += nbytes_of(nxt)
-                    r.tensor_names.extend(nxt.tensor_names)
-                    r.tensor_shapes.extend(nxt.tensor_shapes)
-                    responses.pop(j)
-                else:
-                    j += 1
+                r.tensor_names.extend(nxt.tensor_names)
+                r.tensor_shapes.extend(nxt.tensor_shapes)
             fused.append(r)
         with self._lock:
             for r in fused:
                 for n in r.tensor_names:
                     self._resp_dtype.pop(n, None)
+                    self._resp_nbytes.pop(n, None)
         # The JOIN release comes LAST: joined ranks must execute this
         # batch's data responses (with zero contributions) before being
         # released from join().
@@ -486,10 +480,18 @@ class NativeCoordinator:
     """ctypes facade over native/coordinator.cc (same wire format)."""
 
     def __init__(self, size: int, fusion_threshold: int):
+        import ctypes
+
         self._lib = _native.raw()
         self._ptr = self._lib.hvd_coord_create(size, fusion_threshold)
         self.size = size
         self.fusion_threshold = fusion_threshold
+        # Response fetch buffer, reused across polls: poll runs every
+        # 5 ms tick, and a fresh 1 MB create_string_buffer per call is
+        # a 1 MB memset on the steady-state hot path.  Only the drain
+        # thread polls, so one buffer is safe.
+        self._out_cap = 1 << 20
+        self._out = ctypes.create_string_buffer(self._out_cap)
 
     def submit(self, req: Request, now: Optional[float] = None) -> bool:
         buf = req.pack()
@@ -511,23 +513,22 @@ class NativeCoordinator:
         self._lib.hvd_coord_withdraw(self._ptr, nb, len(nb), rank)
 
     def poll_responses(self, sizes_bytes: Dict[str, int]) -> List[Response]:
-        import ctypes
         # Ship the payload sizes as a serialized side table.
         import struct
         side = struct.pack("<H", len(sizes_bytes))
         for k, v in sizes_bytes.items():
             kb = k.encode()
             side += struct.pack("<H", len(kb)) + kb + struct.pack("<q", v)
-        cap = 1 << 20
-        out = ctypes.create_string_buffer(cap)
         n = self._lib.hvd_coord_poll_responses(self._ptr, side, len(side), 0.0)
         if n < 0:
             raise RuntimeError("native coordinator poll failed")
-        # Responses are fetched via a second call writing into out.
-        n = self._lib.hvd_coord_fetch_responses(self._ptr, out, cap)
+        # Responses are fetched via a second call writing into the
+        # reused buffer.
+        n = self._lib.hvd_coord_fetch_responses(self._ptr, self._out,
+                                                self._out_cap)
         if n < 0:
             raise RuntimeError("native coordinator fetch overflow")
-        return wire.unpack_response_list(out.raw[:n])
+        return wire.unpack_response_list(self._out.raw[:n])
 
     def check_stalled(self, now: Optional[float] = None,
                       threshold: float = STALL_WARNING_SECONDS) -> List[str]:
@@ -560,9 +561,21 @@ class Coordinator:
     rank-divergent program ORDER — which the name-keyed request table
     below can only ever stall on — is converted into an immediate ERROR
     response naming the first divergent entry, before any data-plane
-    work."""
+    work.
 
-    def __init__(self, size: int, fusion_threshold: int, timeline=None):
+    With a :class:`~horovod_tpu.ops.cache.ResponseCache` attached it
+    also runs the steady-state fast path ABOVE both implementations: a
+    submit whose packed request matches a cached negotiation is
+    accounted as a cache hit instead of entering the request table, and
+    fully-hit cycles replay from the cache (the drain loop drains them
+    via ``cache.take_ready``), skipping ``submit`` and
+    ``construct_response`` entirely.  Successful negotiations are
+    retained per rank (``_inflight``) and staged into the cache at poll
+    time so the insertion that follows — driven by the broadcast
+    response stream — can store each rank's exact request."""
+
+    def __init__(self, size: int, fusion_threshold: int, timeline=None,
+                 cache=None, ranks=None):
         self.timeline = timeline
         self._last_stall_check = time.monotonic()
         # Gate on the newest symbol so a stale prebuilt .so falls back to
@@ -573,20 +586,49 @@ class Coordinator:
         else:
             self._impl = PyCoordinator(size, fusion_threshold)
         self.size = size
+        self.cache = cache
+        # Global rank per set-local index (identity for the global set);
+        # cache entries account readiness in global ranks so worker bits
+        # and process-set submits share one table.
+        self._ranks = tuple(ranks) if ranks is not None \
+            else tuple(range(size))
+        self._inflight_lock = _lockorder.make_lock("Coordinator._inflight")
+        # name -> {global rank -> Request} of in-negotiation requests,
+        # retained for cache insertion once the response broadcasts.
+        self._inflight: Dict[str, Dict[int, Request]] = {}  # guarded_by: _inflight_lock
+        # True when the underlying impl has seen a submit/withdraw since
+        # the last poll: in the cache steady state every request is
+        # served as a hit, and polling an untouched impl every 5 ms tick
+        # is pure overhead (the native impl's poll crosses ctypes).
+        # Benign flag race: cleared BEFORE the poll, so a concurrent
+        # submit is picked up next tick at the latest.
+        self._impl_dirty = True
         self._tracker = (_program.ProgramTracker(size)
                          if _program.program_check_enabled() else None)
         self._tracker_lock = _lockorder.make_lock("Coordinator._tracker")
         # guarded_by: _tracker_lock
         self._program_errors: List[Response] = []
 
+    @property
+    def fusion_threshold(self) -> int:
+        return self._impl.fusion_threshold
+
     def submit(self, req: Request) -> bool:
+        done, _ = self.submit_ex(req)
+        return done
+
+    def submit_ex(self, req: Request) -> "tuple[bool, bool]":
+        """Submit one request; returns (negotiation_complete,
+        served_from_cache)."""
         if self.timeline is not None:
             self.timeline.negotiate_rank_ready(req.tensor_name,
                                                req.request_rank,
                                                first=req.request_rank == 0)
         if self._tracker is not None:
             # JOIN disables the tracker (join legalizes rank-divergent
-            # programs — see ProgramTracker).
+            # programs — see ProgramTracker).  The tracker and the
+            # response cache are mutually exclusive (cache_enabled), so
+            # every request reaches this feed when tracking.
             diag = self._tracker.feed(req)
             if diag is not None:
                 # Fail the divergent op on every rank at the next poll —
@@ -596,16 +638,74 @@ class Coordinator:
                         ResponseType.ERROR, [req.tensor_name],
                         error_message=diag,
                         process_set_id=req.process_set_id))
+        if self.cache is not None:
+            if req.request_type == RequestType.JOIN:
+                # Joined ranks complete tensors they never requested;
+                # such negotiations must not become cache entries, and
+                # existing entries' rank accounting no longer holds.
+                self._resubmit(self.cache.disarm("hvd.join()"))
+            else:
+                kind, info = self.cache.lookup_and_hit(req)
+                if self.timeline is not None:
+                    self.timeline.cache_event(req.tensor_name,
+                                              hit=kind == "hit")
+                    st = self.cache.stats
+                    self.timeline.cache_counter(st.hits, st.misses)
+                if kind == "hit":
+                    # NEGOTIATE-span closure for cache-served tensors
+                    # happens once, at replay time in the drain tick —
+                    # the completing hit may be a remote bit this
+                    # submit path never sees.
+                    return bool(info), True
+                if kind == "conflict":
+                    # The program changed mid-run: the cache flushed;
+                    # the peers' raced cached submissions downgrade to
+                    # real negotiation so nothing is lost, and THIS
+                    # request follows them through the normal path
+                    # (surfacing the usual mismatch diagnostics).
+                    self._resubmit(info)
+                self._retain(req)
+        self._impl_dirty = True
         done = self._impl.submit(req)
         if done and self.timeline is not None:
             self.timeline.negotiate_end(req.tensor_name)
-        return done
+        return done, False
+
+    def _retain(self, req: Request) -> None:
+        local = req.request_rank
+        grank = self._ranks[local] if 0 <= local < len(self._ranks) \
+            else local
+        with self._inflight_lock:
+            self._inflight.setdefault(req.tensor_name, {})[grank] = req
+
+    def _resubmit(self, orphans: List[Request]) -> None:
+        """Feed cached submissions back through the real negotiation
+        path (cache flush / conflict / withdraw downgrades)."""
+        for req in orphans:
+            try:
+                self._retain(req)
+                self._impl_dirty = True
+                self._impl.submit(req)
+            except ValueError:
+                pass  # duplicate: the rank re-submitted meanwhile
 
     def withdraw(self, name: str, rank: int) -> None:
+        if self.cache is not None:
+            # A withdrawal is a program-divergence signal (a rank timed
+            # out waiting): invalidate, downgrading any mid-flight
+            # cached submissions so the impl's withdraw below can fail
+            # the op group-wide with the standard diagnosis.
+            self._resubmit(self.cache.flush(
+                f"withdraw of {name!r} by rank {rank}", broadcast=True))
+        self._impl_dirty = True
         self._impl.withdraw(name, rank)
 
     def set_fusion_threshold(self, v: int) -> None:
         self._impl.set_fusion_threshold(v)
+        if self.cache is not None:
+            # Entries stay valid (the negotiated outcome is threshold-
+            # independent) but every memoized packing plan is stale.
+            self.cache.invalidate_plans(f"fusion threshold -> {v}")
 
     def poll_responses(self, sizes_bytes: Dict[str, int]) -> List[Response]:
         now = time.monotonic()
@@ -613,7 +713,32 @@ class Coordinator:
             self._last_stall_check = now
             for w in self._impl.check_stalled(now):
                 print(f"WARNING: {w}", file=sys.stderr)
-        resps = self._impl.poll_responses(sizes_bytes)
+        if self.cache is not None and not self._impl_dirty:
+            # Steady state: every request since the last poll was a
+            # cache hit, so the impl's tables are exactly as the last
+            # poll left them — empty of ready work.
+            resps: List[Response] = []
+        else:
+            self._impl_dirty = False
+            resps = self._impl.poll_responses(sizes_bytes)
+        if self.cache is not None and resps:
+            staged = []
+            with self._inflight_lock:
+                for r in resps:
+                    if r.response_type in (ResponseType.ALLREDUCE,
+                                           ResponseType.ALLGATHER,
+                                           ResponseType.BROADCAST,
+                                           ResponseType.REDUCESCATTER,
+                                           ResponseType.ALLTOALL):
+                        for n in r.tensor_names:
+                            reqs = self._inflight.pop(n, None)
+                            if reqs:
+                                staged.append((n, reqs))
+                    else:
+                        for n in r.tensor_names:
+                            self._inflight.pop(n, None)
+            for n, reqs in staged:
+                self.cache.stage_negotiated(n, reqs)
         with self._tracker_lock:
             if self._program_errors:
                 resps = self._program_errors + resps

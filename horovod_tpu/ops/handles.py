@@ -32,13 +32,17 @@ from ..native import lib as _native
 class Handle:
     """One in-flight eager collective."""
 
-    __slots__ = ("id", "result", "finalizer", "name")
+    __slots__ = ("id", "result", "finalizer", "name", "cache_hit")
 
     def __init__(self, id: int, result: Any, finalizer: Optional[Callable], name: str):
         self.id = id
         self.result = result  # jax.Array or pytree of jax.Arrays
         self.finalizer = finalizer  # host-side post-processing (e.g. unpad)
         self.name = name
+        # True when negotiation was served from the response cache
+        # (ops/cache.py) — set by _enqueue once the request is routed;
+        # observability for timeline args and tests.
+        self.cache_hit = False
 
 
 class HandleManager:

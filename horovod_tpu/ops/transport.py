@@ -25,6 +25,7 @@ import os
 import queue
 import socket
 import struct
+import sys
 import threading
 import time
 from dataclasses import dataclass
@@ -37,8 +38,11 @@ from .wire import DEAD_PEER_MARKER, Request, Response, ResponseType
 FRAME_HELLO = 0       # worker→controller: <i rank><H len><hostname>
 FRAME_REQUEST = 1     # worker→controller: packed Request
 FRAME_RESPONSES = 2   # controller→worker: packed response list
-FRAME_TOPO = 3        # controller→worker: <iiii> local_rank local_size
-                      #                           cross_rank cross_size
+FRAME_TOPO = 3        # controller→worker: <iiiii> local_rank local_size
+                      #   cross_rank cross_size cache_enabled — the last
+                      #   int advertises whether rank 0 runs the response
+                      #   cache, so a worker never populates a replica
+                      #   the controller cannot resolve bits against
 FRAME_SHUTDOWN = 4    # either direction: cooperative shutdown
 FRAME_WITHDRAW = 5    # worker→controller: <i rank><H len><name><H psid> —
                       # the rank's synchronize timed out on <name>; the
@@ -52,6 +56,19 @@ FRAME_SIGNATURE = 6   # worker→controller: <i rank><I round> + packed
                       # complete a later one
 FRAME_SIGRESULT = 7   # controller→worker: <I round><B ok> + utf-8
                       # diagnostic
+FRAME_REQUEST_BATCH = 8   # worker→controller, one per drain tick:
+                          # <i rank><I epoch><I nbitbytes><bit-vector>
+                          # <H nreq><packed Requests...> — the bit-vector
+                          # marks response-cache hits by entry index
+                          # (ops/cache.py); full requests ride the same
+                          # frame, so the steady state costs ONE frame
+                          # per tick instead of one per tensor
+FRAME_RESPONSE_BATCH = 9  # controller→worker: <I epoch><H ngroups>
+                          # (<H n><I idx>*)* — a pure cache-replay cycle
+                          # as fused entry-index groups; each worker
+                          # reconstitutes the identical fused response
+                          # list from its cache replica instead of
+                          # re-parsing full Response payloads
 
 _HDR = struct.Struct("<IB")
 
@@ -113,6 +130,9 @@ class ControllerTransport:
     def __init__(self, coordinator, num_processes: int, port: int,
                  hostname: Optional[str] = None):
         self.coordinator = coordinator
+        # Shared response-cache replica (ops/cache.py), attached by
+        # core.state.init after construction; None = caching disabled.
+        self.cache = None
         self.num_processes = num_processes
         self.shutdown_requested = threading.Event()
         # Ranks whose connection dropped without a SHUTDOWN frame — i.e.
@@ -167,12 +187,15 @@ class ControllerTransport:
             (hlen,) = struct.unpack_from("<H", payload, 4)
             hosts[rank] = payload[6:6 + hlen].decode("utf-8")
             socks[rank] = conn
+        from . import cache as _cache_mod
+
         self.topology = _assign_topology(hosts)
         for rank, conn in socks.items():
             t = self.topology[rank]
             _send_frame(conn, FRAME_TOPO, struct.pack(
-                "<iiii", t.local_rank, t.local_size,
-                t.cross_rank, t.cross_size))
+                "<iiiii", t.local_rank, t.local_size,
+                t.cross_rank, t.cross_size,
+                1 if _cache_mod.cache_enabled() else 0))
         with self._lock:
             self._conns = socks
         for rank, conn in socks.items():
@@ -221,6 +244,8 @@ class ControllerTransport:
                     with self._lock:
                         self._unrouted.append(
                             (time.monotonic() + 5.0, req))
+            elif ftype == FRAME_REQUEST_BATCH:
+                self._handle_request_batch(payload)
             elif ftype == FRAME_SHUTDOWN:
                 self.shutdown_requested.set()
             elif ftype == FRAME_SIGNATURE:
@@ -241,6 +266,42 @@ class ControllerTransport:
                 coord = self._route_coord(psid)
                 if coord is not None:
                     coord.withdraw(name, wrank)
+
+    def _handle_request_batch(self, payload: bytes) -> None:
+        """One worker drain tick's coalesced control frame: a cache-hit
+        bit-vector (entry indices into the shared response cache) plus
+        any full requests.  A bit whose epoch predates the live cache
+        generation is DOWNGRADED into a real submit of the retired
+        entry's stored request — a flush can delay a submission but
+        never lose it."""
+        srank, epoch, nbits = struct.unpack_from("<iII", payload)
+        off = 12
+        bitvec = payload[off:off + nbits]
+        off += nbits
+        (nreq,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        cache = self.cache
+        for byte_i, b in enumerate(bitvec):
+            while b:
+                low = b & -b
+                idx = byte_i * 8 + low.bit_length() - 1
+                b ^= low
+                if cache is None:
+                    print(f"WARNING: rank {srank} sent a response-cache "
+                          f"bit but the controller cache is disabled "
+                          f"(HVD_TPU_RESPONSE_CACHE mismatch across "
+                          f"ranks?)", file=sys.stderr)
+                    continue
+                down = cache.hit_from_wire(idx, srank, epoch)
+                if down is not None and not self._try_submit(down):
+                    with self._lock:
+                        self._unrouted.append(
+                            (time.monotonic() + 5.0, down))
+        for _ in range(nreq):
+            req, off = Request.unpack(payload, off)
+            if not self._try_submit(req):
+                with self._lock:
+                    self._unrouted.append((time.monotonic() + 5.0, req))
 
     def _route_coord(self, psid: int):
         """Coordinator for a process-set id (0 = global); None when the
@@ -332,11 +393,22 @@ class ControllerTransport:
                     pass  # worker already gone; its own timeout reports
 
     # -- controller-side API used by the drain loop ------------------------
-    def submit(self, req: Request) -> None:
-        if not self._try_submit(req):
+    def submit(self, req: Request) -> bool:
+        """Rank 0's own submit; returns True when the request was served
+        from the response cache (the coordinator facade's fast path)."""
+        coord = self._route_coord(req.process_set_id)
+        if coord is None:
             raise RuntimeError(
                 f"process set {req.process_set_id} is not registered on "
                 f"the controller")
+        try:
+            if hasattr(coord, "submit_ex"):
+                _, hit = coord.submit_ex(req)
+                return hit
+            coord.submit(req)
+        except ValueError:
+            pass  # duplicate-name caller bug; surfaces via timeout
+        return False
 
     def broadcast_responses(self, responses: List[Response]) -> None:
         payload = wire.pack_response_list(responses)
@@ -349,6 +421,25 @@ class ControllerTransport:
             for conn in conns:
                 try:
                     _send_frame(conn, FRAME_RESPONSES, payload)
+                except OSError:
+                    pass  # worker already gone; its own stall path reports
+
+    def broadcast_replay(self, groups: List[List[int]],
+                         epoch: int) -> None:
+        """Broadcast a pure cache-replay cycle as fused entry-index
+        groups (FRAME_RESPONSE_BATCH) — a handful of bytes per tensor
+        instead of full Response payloads; each worker reconstitutes the
+        identical fused response list from its cache replica."""
+        payload = struct.pack("<IH", epoch, len(groups))
+        for g in groups:
+            payload += struct.pack("<H", len(g))
+            payload += struct.pack(f"<{len(g)}I", *g)
+        with self._send_lock:
+            with self._lock:
+                conns = list(self._conns.values())
+            for conn in conns:
+                try:
+                    _send_frame(conn, FRAME_RESPONSE_BATCH, payload)
                 except OSError:
                     pass  # worker already gone; its own stall path reports
 
@@ -377,8 +468,16 @@ class WorkerTransport:
                  hostname: Optional[str] = None,
                  connect_timeout: float = 60.0):
         self.rank = rank
+        # Shared response-cache replica (ops/cache.py), attached by
+        # core.state.init after construction; None = caching disabled.
+        self.cache = None
         self.shutdown_received = threading.Event()
         self._closing = False
+        self._buf_lock = _lockorder.make_lock("WorkerTransport._buf_lock")
+        # One drain tick's outgoing control traffic, coalesced into a
+        # single FRAME_REQUEST_BATCH by flush_requests: ("bit", epoch,
+        # entry_idx) response-cache hits and ("req", packed) fulls.
+        self._pending: List[tuple] = []  # guarded_by: _buf_lock
         self._responses: "queue.Queue[List[Response]]" = queue.Queue()
         # verify_program verdicts (FRAME_SIGRESULT) as (round, verdict);
         # the round counter lets exchange_signature discard a stale
@@ -410,7 +509,13 @@ class WorkerTransport:
         if ftype != FRAME_TOPO:
             raise RuntimeError(
                 f"rank {rank} expected TOPO from controller, got {ftype}")
-        lr, ls, cr, cs = struct.unpack("<iiii", payload)
+        lr, ls, cr, cs = struct.unpack_from("<iiii", payload)
+        # The controller's response-cache advertisement: a worker whose
+        # own env enables the cache must still run WITHOUT a replica
+        # when rank 0 cannot resolve its bits (core.state.init reads
+        # this before attaching the cache).
+        self.controller_cache = bool(struct.unpack_from(
+            "<i", payload, 16)[0]) if len(payload) >= 20 else True
         self.topology = Topology(lr, ls, cr, cs)
         self._rx = threading.Thread(target=self._recv_loop,
                                     name=f"hvd-worker-rx-{rank}", daemon=True)
@@ -459,6 +564,36 @@ class WorkerTransport:
                         f"rank-0 controller {DEAD_PEER_MARKER} while "
                         "collectives were pending.")])
                 return
+            if ftype == FRAME_RESPONSE_BATCH:
+                epoch, ngroups = struct.unpack_from("<IH", payload)
+                off = 6
+                groups = []
+                for _ in range(ngroups):
+                    (n,) = struct.unpack_from("<H", payload, off)
+                    off += 2
+                    groups.append(list(struct.unpack_from(
+                        f"<{n}I", payload, off)))
+                    off += 4 * n
+                try:
+                    if self.cache is None:
+                        raise RuntimeError(
+                            "replay frame without a cache replica "
+                            "(HVD_TPU_RESPONSE_CACHE mismatch across "
+                            "ranks?)")
+                    resps = self.cache.rebuild_groups(groups, epoch)
+                except RuntimeError as e:
+                    # A replica desync is a protocol bug: fail the job
+                    # loudly instead of executing desynced responses.
+                    print(f"ERROR: rank {self.rank}: {e}",
+                          file=sys.stderr)
+                    self._responses.put([Response(
+                        ResponseType.SHUTDOWN,
+                        error_message="Horovod has been shut down: "
+                        f"response-cache replica desync on rank "
+                        f"{self.rank}: {e}")])
+                    continue
+                self._responses.put(resps)
+                continue
             if ftype == FRAME_SIGRESULT:
                 (rnd,) = struct.unpack_from("<I", payload)
                 ok = payload[4:5] == b"\x01"
@@ -475,11 +610,64 @@ class WorkerTransport:
                     self.shutdown_received.set()
                 self._responses.put(resps)
 
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request) -> bool:
+        """Buffer one request for the next coalesced control frame;
+        returns True when it was served from the response cache (a hit
+        bit ships instead of the full request).  The buffer flushes on
+        every local drain tick and before any other outgoing frame, so
+        a sync collective's request leaves within its first synchronize
+        poll — coalescing batches a tick's traffic, it does not delay
+        the conversation."""
+        hit = False
+        item: tuple
+        cache = self.cache
+        if cache is not None and req.request_type != wire.RequestType.JOIN:
+            pos = cache.worker_lookup(req)
+            if pos is not None:
+                epoch, idx = pos
+                item = ("bit", epoch, idx)
+                hit = True
+        if not hit:
+            item = ("req", req.pack())
+        with self._buf_lock:
+            self._pending.append(item)
+        return hit
+
+    def flush_requests(self) -> None:
+        """Ship the buffered tick's requests + cache-hit bits as one
+        FRAME_REQUEST_BATCH (one frame per distinct cache epoch — more
+        than one only when a flush marker raced this tick's hits)."""
+        with self._buf_lock:
+            items, self._pending = self._pending, []
+        if not items:
+            return
+        by_epoch: Dict[int, List[int]] = {}
+        reqs: List[bytes] = []
+        for item in items:
+            if item[0] == "bit":
+                by_epoch.setdefault(item[1], []).append(item[2])
+            else:
+                reqs.append(item[1])
+        epochs = sorted(by_epoch) or [0]
         with self._send_lock:
-            _send_frame(self._sock, FRAME_REQUEST, req.pack())
+            for i, epoch in enumerate(epochs):
+                idxs = by_epoch.get(epoch, [])
+                bitvec = b""
+                if idxs:
+                    arr = bytearray(max(idxs) // 8 + 1)
+                    for b in idxs:
+                        arr[b // 8] |= 1 << (b % 8)
+                    bitvec = bytes(arr)
+                # The full requests ride the last epoch's frame.
+                tail = b"".join(reqs) if i == len(epochs) - 1 else b""
+                nreq = len(reqs) if i == len(epochs) - 1 else 0
+                _send_frame(
+                    self._sock, FRAME_REQUEST_BATCH,
+                    struct.pack("<iII", self.rank, epoch, len(bitvec))
+                    + bitvec + struct.pack("<H", nreq) + tail)
 
     def request_shutdown(self) -> None:
+        self.flush_requests()  # preserve request-before-shutdown order
         with self._send_lock:
             _send_frame(self._sock, FRAME_SHUTDOWN)
 
@@ -492,6 +680,7 @@ class WorkerTransport:
         verdict queued by a timed-out earlier round is discarded."""
         self._sig_round += 1
         rnd = self._sig_round
+        self.flush_requests()  # keep buffered requests ahead in-stream
         with self._send_lock:
             _send_frame(self._sock, FRAME_SIGNATURE,
                         struct.pack("<iI", self.rank, rnd) + payload)
@@ -517,6 +706,7 @@ class WorkerTransport:
         synchronize timed out); the coordinator of ``process_set_id``
         fails the op group-wide."""
         nb = name.encode("utf-8")
+        self.flush_requests()  # keep buffered requests ahead in-stream
         with self._send_lock:
             _send_frame(self._sock, FRAME_WITHDRAW,
                         struct.pack("<i", self.rank)

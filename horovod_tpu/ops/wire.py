@@ -141,7 +141,10 @@ class ResponseType(IntEnum):
     """≙ MPIResponseType (mpi_message.h) — ERROR carries a cross-replica
     validation message; DONE/SHUTDOWN close the negotiation; JOIN
     releases every joined rank (tensor_sizes carries the last joining
-    rank, hvd.join()'s return value)."""
+    rank, hvd.join()'s return value).  CACHE_FLUSH is a response-cache
+    epoch marker (ops/cache.py): it rides the broadcast response list so
+    every rank flushes its cache replica at the same position of the
+    response stream; tensor_sizes carries [new_epoch, disarm_flag]."""
 
     ALLREDUCE = 0
     ALLGATHER = 1
@@ -152,6 +155,7 @@ class ResponseType(IntEnum):
     JOIN = 6
     REDUCESCATTER = 7
     ALLTOALL = 8
+    CACHE_FLUSH = 9
 
 
 # Device id of a host-resident tensor (≙ CPU_DEVICE_ID, common.h:28).

@@ -114,7 +114,8 @@ class Timeline:
 
     # -- negotiation phase (timeline.cc:106-134) ---------------------------
     def negotiate_start(self, tensor: str, op_name: str) -> None:
-        self._event(_PH_BEGIN, tensor, f"NEGOTIATE_{op_name.upper()}")
+        self._event(_PH_BEGIN, tensor, f"NEGOTIATE_{op_name.upper()}",
+                    args={"phase": "NEGOTIATE"})
 
     def negotiate_rank_ready(self, tensor: str, rank: int,
                              first: bool = False) -> None:
@@ -122,6 +123,32 @@ class Timeline:
 
     def negotiate_end(self, tensor: str) -> None:
         self._event(_PH_END, tensor)
+
+    # -- response cache (ops/cache.py) -------------------------------------
+    def cache_event(self, tensor: str, hit: bool) -> None:
+        """Instant marker on the tensor's row: its negotiation was
+        served from (CACHE_HIT) or missed (CACHE_MISS) the response
+        cache, so per-tensor cache wins read straight off the trace."""
+        self._event(_PH_INSTANT, tensor,
+                    "CACHE_HIT" if hit else "CACHE_MISS",
+                    args={"cache": "hit" if hit else "miss"})
+
+    def cache_counter(self, hits: int, misses: int) -> None:
+        """Chrome counter track of cumulative response-cache hits vs
+        misses (ph="C" renders as a stacked area in the trace viewer).
+        The native writer has no counter phase; it records the same
+        data as an instant on a dedicated row."""
+        with self._lock:
+            if self._native is not None:
+                _native.raw().hvd_timeline_event(
+                    self._native, 2, b"response_cache",
+                    b"response_cache",
+                    json.dumps({"hit": hits, "miss": misses}).encode(),
+                    0.0)
+                return
+            self._emit_locked({"ph": "C", "ts": self._ts_us(), "pid": 0,
+                               "name": "response_cache",
+                               "args": {"hit": hits, "miss": misses}})
 
     # -- top-level + activities (timeline.cc:136-182) ----------------------
     def start(self, tensor: str, op_name: str, args: Optional[dict] = None

@@ -928,6 +928,112 @@ def scenario_verify(hvd):
     print(f"VERIFY_ALL_OK rank={rank}")
 
 
+def scenario_cache(hvd):
+    """Response-cache steady state + every invalidation hook across REAL
+    processes (ops/cache.py): after the first negotiation of a repeated
+    named program, workers ship one coalesced bit-vector frame per tick
+    and rank 0 replays cached responses (skipping submit/
+    construct_response); a mid-run program change, hvd.join(), process-
+    set add/remove and an autotune threshold update each flush the
+    cache with a logged marker while results stay exactly correct.
+    Runs identically with HVD_TPU_RESPONSE_CACHE=0 (minus the stats
+    asserts) — the numerical-identity leg of the acceptance criteria."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import HorovodError
+    from horovod_tpu.core import state as _st
+
+    rank = hvd.rank()
+    st = _st.global_state()
+    cache = st.response_cache
+    cache_on = os.environ.get("HVD_TPU_RESPONSE_CACHE", "1") != "0"
+    assert (cache is not None) == cache_on, (cache, cache_on)
+
+    # Leg 1 — steady state: the identical named program for 4 steps.
+    # Values are rank- and step-dependent so a replayed response feeding
+    # the wrong op (or a stale cached result) cannot produce them.
+    for step in range(4):
+        for i in range(3):
+            out = hvd.allreduce(
+                jnp.full((4,), float(rank + 1) * (i + 1)),
+                average=False, name=f"c.grad.{i}")
+            np.testing.assert_allclose(np.asarray(out), 3.0 * (i + 1))
+        g = np.asarray(hvd.allgather(
+            jnp.full((rank + 1, 2), float(rank)), name="c.gather"))
+        assert g.shape == (3, 2), g.shape
+        np.testing.assert_allclose(g[:1], 0.0)
+        np.testing.assert_allclose(g[1:], 1.0)
+        b = np.asarray(hvd.broadcast(jnp.full((2,), float(rank)), 1,
+                                     name="c.bcast"))
+        np.testing.assert_allclose(b, 1.0)
+    hits = 0
+    if cache_on:
+        s = cache.stats
+        hits = s.hits
+        assert s.hits > 0, s  # every rank's replica must be serving
+        if rank == 0:
+            assert s.replayed_tensors > 0, s
+    print(f"CACHE_STEADY_OK rank={rank} hits={hits}")
+
+    # Leg 2 — program change mid-run: the same name returns with a new
+    # (rank-divergent) shape.  The cached cycle must flush (logged) and
+    # the standard cross-rank mismatch diagnosis must fire — not a
+    # stale replay of the old shape.
+    try:
+        hvd.allreduce(jnp.ones((2 + rank,)), average=False,
+                      name="c.grad.0")
+        raise AssertionError("changed program did not raise")
+    except HorovodError as e:
+        assert "Mismatched allreduce tensor shapes" in str(e), str(e)
+    out = hvd.allreduce(jnp.ones((2,)), average=False, name="c.recover")
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    print(f"CACHE_CHANGE_OK rank={rank}")
+
+    # Leg 3 — hvd.join(): rank 0 runs out after 2 steps; negotiations
+    # completed via the join must not poison the cache (insertion is
+    # disarmed until the release), and results stay exact.
+    steps = 2 if rank == 0 else 4
+    for i in range(steps):
+        out = hvd.allreduce(jnp.full((3,), float(rank + 1)),
+                            average=False, name=f"c.join.{i}")
+        want = 3.0 if i < 2 else 2.0  # rank 0 joined: zeros + rank 1
+        np.testing.assert_allclose(np.asarray(out), want)
+    assert hvd.join() == 1
+    out = hvd.allreduce(jnp.ones((2,)), average=False, name="c.post.join")
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    print(f"CACHE_JOIN_OK rank={rank}")
+
+    # Leg 4 — process-set add/remove: both flush every replica at the
+    # registration allgather's stream position; set collectives and the
+    # global set keep working before, between and after.
+    ps = hvd.add_process_set([0, 1])
+    out = hvd.allreduce(jnp.full((2,), float(rank + 1)), average=False,
+                        process_set=ps, name="c.ps")
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    assert hvd.remove_process_set(ps)
+    out = hvd.allreduce(jnp.ones((2,)), average=False, name="c.ps.after")
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    print(f"CACHE_PSETS_OK rank={rank}")
+
+    # Leg 5 — autotune fusion-threshold update: entries survive, the
+    # memoized packing plans flush (logged on the coordinator).
+    for _ in range(2):  # second pass replays → builds a cached plan
+        out = hvd.allreduce(jnp.ones((2,)), average=False, name="c.tune")
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+    if rank == 0 and st.coordinator is not None:
+        st.coordinator.set_fusion_threshold(1 << 20)
+    out = hvd.allreduce(jnp.ones((2,)), average=False, name="c.tune")
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    print(f"CACHE_TUNE_OK rank={rank}")
+
+    if cache_on:
+        s = cache.stats
+        assert s.flushes > 0, s
+        print(f"CACHE_OK rank={rank} hits={s.hits} flushes={s.flushes}")
+    else:
+        print(f"CACHE_OK rank={rank} hits=0 flushes=0")
+
+
 def scenario_combo(hvd):
     """Run several NON-DESTRUCTIVE scenarios sequentially in ONE launch
     (``HVD_TPU_COMBO`` names them, comma-separated).  Every separate

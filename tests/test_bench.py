@@ -34,6 +34,28 @@ def test_bench_smoke_emits_contract_json():
     assert "probe_ok" in events and "measure_ok" in events, payload
 
 
+def test_bench_control_mode_contract_and_speedup():
+    """`--mode control` (round 6): the control-plane microbench emits
+    one contract JSON line — no XLA, no tunnel, so it is fast enough
+    for tier-1 — and the response cache must show a real speedup (the
+    CI job gates at 2x; this asserts a loaded-machine-safe floor)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "control", "--control-seconds", "0.3"],
+        env=dict(os.environ), cwd=REPO, capture_output=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    lines = [ln for ln in proc.stdout.decode().splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1, proc.stdout.decode()
+    payload = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "cache_on",
+                "cache_off", "speedup"):
+        assert key in payload, payload
+    assert payload["metric"] == "control_plane_negotiations_per_sec"
+    assert payload["cache_on"] > 0 and payload["cache_off"] > 0
+    assert payload["speedup"] >= 1.5, payload
+
+
 @pytest.mark.slow
 def test_bench_failure_still_emits_contract_json():
     """A dead backend: the probe retries with backoff inside the budget

@@ -1,0 +1,511 @@
+"""Response-cache unit tests (ops/cache.py): key exactness, hit/replay
+through the Coordinator facade, memoized fusion plans, every
+invalidation hook (program change, join, process-set membership,
+autotune threshold, withdraw, capacity), the coalesced wire fast path
+over real sockets, and single-process end-to-end numerical identity
+cache on vs off."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ops import cache as hvd_cache
+from horovod_tpu.ops import wire
+from horovod_tpu.ops.cache import ResponseCache, plan_fusion, request_key
+from horovod_tpu.ops.coordinator import Coordinator
+from horovod_tpu.ops.wire import (DataType, ReduceOp, Request, RequestType,
+                                  Response, ResponseType)
+
+THRESHOLD = 1 << 20
+
+
+def _req(rank, name, shape=(4,), op=RequestType.ALLREDUCE,
+         dtype=DataType.FLOAT32, root=-1, device=-1,
+         red=ReduceOp.SUM, psid=0, splits=()):
+    return Request(rank, op, dtype, name, root, device, shape, red, psid,
+                   splits)
+
+
+def _tick(coord, cache):
+    """One controller drain tick, exactly as ops/collective._drain
+    sequences it: marker, replay, fresh negotiation, observation."""
+    resps = []
+    marker = cache.take_flush_marker()
+    if marker is not None:
+        resps.append(marker)
+    replayed, groups, epoch, compact = cache.take_ready(
+        lambda psid: THRESHOLD)
+    resps += replayed
+    negotiated = coord.poll_responses({})
+    resps += negotiated
+    for r in resps:
+        cache.observe_response(r)
+    return resps, replayed, negotiated
+
+
+# ---------------------------------------------------------------------------
+# Key exactness (the digest-collision satellite)
+# ---------------------------------------------------------------------------
+
+def test_request_key_same_name_different_shape_never_collides():
+    a = request_key(_req(0, "t", shape=(4,)))
+    b = request_key(_req(0, "t", shape=(8,)))
+    c = request_key(_req(0, "t", shape=(4, 1)))
+    assert len({a, b, c}) == 3
+
+
+def test_request_key_covers_every_negotiated_field():
+    base = _req(0, "t")
+    variants = [
+        _req(1, "t"),                                  # rank
+        _req(0, "t", op=RequestType.ALLGATHER),        # op
+        _req(0, "t", dtype=DataType.INT32),            # dtype
+        _req(0, "t", root=1),                          # root
+        _req(0, "t", device=3),                        # device
+        _req(0, "t", red=ReduceOp.MAX),                # reduce op
+        _req(0, "t", psid=2),                          # process set
+        _req(0, "t", op=RequestType.ALLTOALL,
+             splits=(2, 2)),                           # splits
+    ]
+    keys = {request_key(base)} | {request_key(v) for v in variants}
+    assert len(keys) == len(variants) + 1
+
+
+def test_signature_reuses_program_machinery():
+    sig = hvd_cache.signature_of(_req(0, "grad.0", red=ReduceOp.AVERAGE))
+    assert sig.name == "grad.0" and sig.reduce_op == "average"
+    digest = hvd_cache.cycle_digest([sig])
+    assert len(digest) == 64  # sha256 hex, same scheme as verify_program
+
+
+# ---------------------------------------------------------------------------
+# plan_fusion (shared by PyCoordinator and the cache replay)
+# ---------------------------------------------------------------------------
+
+def test_plan_fusion_groups_like_the_reference():
+    def meta(rt=ResponseType.ALLREDUCE, red=ReduceOp.SUM, psid=0,
+             dtype=DataType.FLOAT32, nbytes=16, devices=(0,)):
+        return hvd_cache._FusionMeta(rt, tuple(devices), red, psid, dtype,
+                                     nbytes)
+
+    metas = [
+        meta(),                              # 0: fuses with 2
+        meta(dtype=DataType.INT32),          # 1: dtype splits
+        meta(),                              # 2
+        meta(red=ReduceOp.ADASUM),           # 3: adasum never fuses
+        meta(rt=ResponseType.ALLGATHER),     # 4: only allreduce fuses
+    ]
+    groups = plan_fusion(metas, lambda psid: 1024)
+    assert groups == [[0, 2], [1], [3], [4]]
+    # Threshold exhaustion: 60 + 60 > 100, 60 + 30 fits.
+    metas = [meta(nbytes=60), meta(nbytes=60), meta(nbytes=30)]
+    assert plan_fusion(metas, lambda psid: 100) == [[0, 2], [1]]
+
+
+# ---------------------------------------------------------------------------
+# Hit / replay through the Coordinator facade
+# ---------------------------------------------------------------------------
+
+def _negotiate_program(coord, cache, step):
+    """Submit the same 3-tensor program (2 fusable allreduces + one
+    allgather) for both ranks; returns the tick's responses."""
+    for name in ("a", "b"):
+        for r in range(2):
+            coord.submit(_req(r, name))
+    for r in range(2):
+        coord.submit(_req(r, "g", shape=(2, 3), op=RequestType.ALLGATHER))
+    return _tick(coord, cache)
+
+
+def test_cache_hit_skips_negotiation_and_replays_fused():
+    cache = ResponseCache(rank=0)
+    coord = Coordinator(size=2, fusion_threshold=THRESHOLD, cache=cache)
+    resps0, replayed0, negotiated0 = _negotiate_program(coord, cache, 0)
+    assert not replayed0 and len(negotiated0) == 2  # fused a+b, g
+    assert cache.live_entries() == 3
+    assert cache.stats.hits == 0
+
+    resps1, replayed1, negotiated1 = _negotiate_program(coord, cache, 1)
+    # Every request hit; nothing reached the impl.
+    assert cache.stats.hits == 6
+    assert negotiated1 == []
+    assert len(replayed1) == 2
+    by_type = {r.response_type: r for r in replayed1}
+    assert sorted(by_type[ResponseType.ALLREDUCE].tensor_names) == ["a", "b"]
+    assert by_type[ResponseType.ALLGATHER].tensor_names == ["g"]
+    # The replayed allgather carries the negotiated per-rank extents.
+    assert by_type[ResponseType.ALLGATHER].tensor_sizes == [2, 2]
+    assert cache.stats.plan_misses == 1
+
+    _, replayed2, negotiated2 = _negotiate_program(coord, cache, 2)
+    assert negotiated2 == [] and len(replayed2) == 2
+    assert cache.stats.plan_hits == 1  # memoized packing plan
+    coord.close()
+
+
+def test_program_change_flushes_and_surfaces_mismatch(capfd):
+    cache = ResponseCache(rank=0)
+    coord = Coordinator(size=2, fusion_threshold=THRESHOLD, cache=cache)
+    for r in range(2):
+        coord.submit(_req(r, "t"))
+    _tick(coord, cache)
+    assert cache.live_entries() == 1
+
+    # Rank 0 hits the cached cycle, then rank 1 shows up with a NEW
+    # shape for the same name: the cache must flush, rank 0's cached
+    # submission must downgrade into the real table, and the normal
+    # cross-rank validation must report the mismatch.
+    coord.submit(_req(0, "t"))
+    coord.submit(_req(1, "t", shape=(8,)))
+    resps, replayed, negotiated = _tick(coord, cache)
+    assert replayed == []
+    errs = [r for r in resps if r.response_type == ResponseType.ERROR]
+    assert len(errs) == 1
+    assert "Mismatched allreduce tensor shapes" in errs[0].error_message
+    assert cache.live_entries() == 0
+    assert cache.stats.downgrades == 0  # in-process conflict, not wire
+    err = capfd.readouterr().err
+    assert "[hvd-cache]" in err and "program change" in err
+
+    # The group recovers: the new agreeing program negotiates and
+    # re-populates the cache.
+    for r in range(2):
+        coord.submit(_req(r, "t", shape=(8,)))
+    _, _, negotiated = _tick(coord, cache)
+    assert len(negotiated) == 1
+    assert negotiated[0].response_type == ResponseType.ALLREDUCE
+    assert cache.live_entries() == 1
+    coord.close()
+
+
+def test_join_disarms_insertion_until_release(capfd):
+    cache = ResponseCache(rank=0)
+    coord = Coordinator(size=2, fusion_threshold=THRESHOLD, cache=cache)
+    for r in range(2):
+        coord.submit(_req(r, "warm"))
+    _tick(coord, cache)
+    assert cache.live_entries() == 1
+
+    # Rank 0 joins: flush + disarm; a tensor completed via the join must
+    # NOT become an entry (the joined rank never sent a request for it).
+    coord.submit(Request(0, RequestType.JOIN, DataType.UINT8, "hvd.join"))
+    assert "hvd.join" in capfd.readouterr().err
+    coord.submit(_req(1, "through.join"))
+    resps, _, negotiated = _tick(coord, cache)
+    assert any(r.response_type == ResponseType.CACHE_FLUSH for r in resps)
+    assert any(r.response_type == ResponseType.ALLREDUCE
+               for r in negotiated)
+    assert cache.live_entries() == 0
+
+    # Rank 1 joins too: the JOIN release rides the stream and re-arms.
+    coord.submit(Request(1, RequestType.JOIN, DataType.UINT8, "hvd.join"))
+    resps, _, _ = _tick(coord, cache)
+    assert any(r.response_type == ResponseType.JOIN for r in resps)
+    for r in range(2):
+        coord.submit(_req(r, "post.join"))
+    _tick(coord, cache)
+    assert cache.live_entries() == 1  # insertion armed again
+    coord.close()
+
+
+def test_membership_allgather_flushes_deterministically():
+    cache = ResponseCache(rank=0)
+    coord = Coordinator(size=2, fusion_threshold=THRESHOLD, cache=cache)
+    for r in range(2):
+        coord.submit(_req(r, "warm"))
+    _tick(coord, cache)
+    assert cache.live_entries() == 1
+    # The registration allgather of add_process_set/remove_process_set:
+    # observing it flushes every replica at the same stream position.
+    for r in range(2):
+        coord.submit(_req(r, "process_set.register.7.sizes", shape=(1,),
+                          op=RequestType.ALLGATHER, dtype=DataType.INT64))
+    _tick(coord, cache)
+    assert cache.live_entries() == 0
+    coord.close()
+
+
+def test_autotune_threshold_change_flushes_plans(capfd):
+    cache = ResponseCache(rank=0)
+    coord = Coordinator(size=2, fusion_threshold=THRESHOLD, cache=cache)
+    _negotiate_program(coord, cache, 0)
+    _negotiate_program(coord, cache, 1)  # builds + memoizes a plan
+    assert cache.stats.plan_misses == 1
+    coord.set_fusion_threshold(123456)
+    err = capfd.readouterr().err
+    assert "fusion plans flushed" in err and "123456" in err
+    # Entries survive — only the packing decision is recomputed.
+    assert cache.live_entries() == 3
+    _negotiate_program(coord, cache, 2)
+    assert cache.stats.plan_misses == 2
+    coord.close()
+
+
+def test_withdraw_flushes_and_still_fails_group_wide():
+    cache = ResponseCache(rank=0)
+    coord = Coordinator(size=2, fusion_threshold=THRESHOLD, cache=cache)
+    for r in range(2):
+        coord.submit(_req(r, "w"))
+    _tick(coord, cache)
+    # Rank 0 hits the cached cycle; rank 1 never shows up and rank 0
+    # withdraws: the cached submission must downgrade so the standard
+    # abandonment ERROR still reaches everyone.
+    coord.submit(_req(0, "w"))
+    coord.withdraw("w", 0)
+    resps, replayed, _ = _tick(coord, cache)
+    assert replayed == []
+    errs = [r for r in resps if r.response_type == ResponseType.ERROR]
+    assert len(errs) == 1
+    assert "was abandoned: rank 0" in errs[0].error_message
+    assert cache.live_entries() == 0
+    coord.close()
+
+
+def test_capacity_flush_is_marker_driven():
+    cache = ResponseCache(rank=0, capacity=2)
+    coord = Coordinator(size=1, fusion_threshold=0, cache=cache)
+    for name in ("a", "b", "c"):
+        coord.submit(_req(0, name))
+    _tick(coord, cache)
+    assert cache.live_entries() == 3  # over capacity until the check
+    orphans = cache.check_capacity()
+    assert orphans == []
+    assert cache.live_entries() == 0
+    marker = cache.take_flush_marker()
+    assert marker is not None
+    assert marker.response_type == ResponseType.CACHE_FLUSH
+    assert marker.tensor_sizes[0] == cache.epoch
+    coord.close()
+
+
+def test_stale_epoch_bit_downgrades_to_real_submit():
+    cache = ResponseCache(rank=0)
+    coord = Coordinator(size=2, fusion_threshold=THRESHOLD, cache=cache)
+    for r in range(2):
+        coord.submit(_req(r, "t"))
+    _tick(coord, cache)
+    old_epoch = cache.epoch
+    cache.flush("test-induced", broadcast=True)
+    # A worker bit that raced the flush: tagged with the retired epoch.
+    down = cache.hit_from_wire(0, 1, old_epoch)
+    assert down is not None and down.tensor_name == "t"
+    assert cache.stats.downgrades == 1
+    # Resolving it through the real path completes with rank 0's own
+    # (also downgraded — via conflictless miss) submission.
+    coord.submit(down)
+    coord.submit(_req(0, "t"))
+    resps, replayed, negotiated = _tick(coord, cache)
+    assert replayed == []
+    kinds = [r.response_type for r in resps]
+    assert ResponseType.ALLREDUCE in kinds
+    coord.close()
+
+
+# ---------------------------------------------------------------------------
+# The coalesced wire fast path over real sockets (no XLA involved)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(os.environ.get("HVD_TPU_NO_SOCKETS") == "1",
+                    reason="sandbox without loopback sockets")
+def test_two_rank_wire_fast_path_bits_and_compact_replay():
+    from horovod_tpu.ops import transport as T
+
+    ctrl_cache = ResponseCache(rank=0)
+    coord = Coordinator(size=2, fusion_threshold=THRESHOLD,
+                        cache=ctrl_cache)
+    holder = {}
+
+    def build_controller():
+        holder["ctrl"] = T.ControllerTransport(coord, 2, 0)
+
+    # ControllerTransport blocks for the worker HELLO; find its port
+    # after bind via the server socket.
+    t = threading.Thread(target=build_controller, daemon=True)
+    # Use an explicit free port: bind a throwaway socket first.
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    def build_controller_on_port():
+        holder["ctrl"] = T.ControllerTransport(coord, 2, port)
+
+    t = threading.Thread(target=build_controller_on_port, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    worker = T.WorkerTransport("127.0.0.1", port, 1)
+    wrk_cache = ResponseCache(rank=1)
+    worker.cache = wrk_cache
+    t.join(timeout=10.0)
+    ctrl = holder["ctrl"]
+    ctrl.cache = ctrl_cache
+
+    try:
+        def controller_tick():
+            resps = []
+            marker = ctrl_cache.take_flush_marker()
+            if marker is not None:
+                resps.append(marker)
+            replayed, groups, epoch, compact = ctrl_cache.take_ready(
+                lambda psid: THRESHOLD)
+            resps += replayed
+            negotiated = coord.poll_responses({})
+            resps += negotiated
+            n_other = (1 if marker else 0) + len(negotiated)
+            if resps:
+                if compact and groups and n_other == 0:
+                    ctrl.broadcast_replay(groups, epoch)
+                else:
+                    ctrl.broadcast_responses(resps)
+            for r in resps:
+                ctrl_cache.observe_response(r)
+            return resps
+
+        def worker_recv(deadline=5.0):
+            end = time.monotonic() + deadline
+            while time.monotonic() < end:
+                got = worker.poll_responses()
+                if got is not None:
+                    return got
+                time.sleep(0.005)
+            raise AssertionError("worker never received the broadcast")
+
+        def cycle(names=("x", "y")):
+            wreqs = {}
+            for name in names:
+                req = _req(1, name)
+                wreqs[name] = req
+                worker.submit(req)
+            worker.flush_requests()
+            for name in names:
+                ctrl.submit(_req(0, name))
+            deadline = time.monotonic() + 5.0
+            resps = []
+            while time.monotonic() < deadline:
+                resps = controller_tick()
+                if resps:
+                    break
+                time.sleep(0.005)
+            assert resps, "controller tick produced nothing"
+            got = worker_recv()
+            for r in got:
+                wrk_cache.observe_response(r, own_requests={
+                    1: wreqs})
+            return resps, got
+
+        # Cycle 1: cold — full requests, negotiated responses, replicas
+        # populated identically on both sides.
+        resps1, got1 = cycle()
+        assert wrk_cache.live_entries() == ctrl_cache.live_entries() == 2
+        assert wrk_cache.stats.hits == 0
+
+        # Cycle 2: steady state — the worker ships ONE coalesced frame
+        # of bits, the controller replays from cache and broadcasts the
+        # compact entry-index frame, and the worker reconstitutes the
+        # identical fused response.
+        resps2, got2 = cycle()
+        assert wrk_cache.stats.hits == 2
+        assert ctrl_cache.stats.replayed_tensors == 2
+        assert [sorted(r.tensor_names) for r in got2] == \
+            [sorted(r.tensor_names) for r in resps2]
+        assert got2[0].response_type == ResponseType.ALLREDUCE
+        assert got2[0].tensor_type == resps2[0].tensor_type
+
+        # Flush marker ride-along: a controller-side flush reaches the
+        # worker through the stream and resets its replica too.
+        ctrl_cache.flush("test-induced", broadcast=True)
+        resps3 = controller_tick()
+        assert any(r.response_type == ResponseType.CACHE_FLUSH
+                   for r in resps3)
+        got3 = worker_recv()
+        for r in got3:
+            wrk_cache.observe_response(r)
+        assert wrk_cache.live_entries() == 0
+        assert wrk_cache.epoch == ctrl_cache.epoch
+    finally:
+        worker.close()
+        ctrl.close()
+
+
+# ---------------------------------------------------------------------------
+# Single-process end-to-end: numerical identity cache on vs off
+# ---------------------------------------------------------------------------
+
+def _run_program():
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    outs = []
+    for step in range(3):
+        for i in range(3):
+            outs.append(np.asarray(hvd.allreduce(
+                jnp.full((4,), float(i + 1)), average=False,
+                name=f"id.grad.{i}")))
+        outs.append(np.asarray(hvd.allgather(
+            jnp.ones((2, 2)), name="id.gather")))
+        outs.append(np.asarray(hvd.broadcast(
+            jnp.arange(3.0), 0, name="id.bcast")))
+    from horovod_tpu.core import state as _st
+
+    stats = None
+    if _st.global_state().response_cache is not None:
+        stats = _st.global_state().response_cache.stats
+        stats = (stats.hits, stats.replayed_tensors)
+    hvd.shutdown()
+    return outs, stats
+
+
+def test_numerical_identity_cache_on_vs_off(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_RESPONSE_CACHE", raising=False)
+    on, stats = _run_program()
+    assert stats is not None and stats[0] > 0 and stats[1] > 0, stats
+    monkeypatch.setenv("HVD_TPU_RESPONSE_CACHE", "0")
+    off, stats_off = _run_program()
+    assert stats_off is None
+    assert len(on) == len(off)
+    for a, b in zip(on, off):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_handle_and_timeline_surface_cache_hits(tmp_path, monkeypatch):
+    import json
+
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.core import state as _st
+
+    monkeypatch.delenv("HVD_TPU_RESPONSE_CACHE", raising=False)
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(tmp_path / "tl.json"))
+    hvd.init()
+    try:
+        h1 = hvd.allreduce_async(jnp.ones((2,)), average=False,
+                                 name="tl.op")
+        assert not _st.global_state().handle_manager._get(h1).cache_hit
+        hvd.synchronize(h1)
+        h2 = hvd.allreduce_async(jnp.ones((2,)), average=False,
+                                 name="tl.op")
+        assert _st.global_state().handle_manager._get(h2).cache_hit
+        hvd.synchronize(h2)
+    finally:
+        hvd.shutdown()
+    text = (tmp_path / "tl.json").read_text()
+    events = json.loads(text if text.rstrip().endswith("]")
+                        else text.rstrip().rstrip(",") + "]")
+    names = [e.get("name") for e in events if isinstance(e, dict)]
+    assert "CACHE_MISS" in names and "CACHE_HIT" in names
+    assert "response_cache" in names  # the hit/miss counter track
+    phases = {e.get("args", {}).get("phase") for e in events
+              if isinstance(e, dict) and isinstance(e.get("args"), dict)}
+    assert {"NEGOTIATE", "EXECUTE"} <= phases
+    cache_args = {e["args"].get("cache") for e in events
+                  if isinstance(e, dict) and isinstance(e.get("args"), dict)
+                  and "cache" in e.get("args", {})}
+    assert {"hit", "miss"} <= cache_args

@@ -241,6 +241,46 @@ def test_elastic_survives_two_sequential_deaths(tmp_path):
         assert f"ELASTIC2_OK rank={r}" in out, out
 
 
+@pytest.mark.slow
+def test_response_cache_two_processes():
+    """Steady-state negotiation bypass across REAL processes
+    (ops/cache.py): coalesced bit-vector request frames, compact replay
+    broadcasts, and every invalidation hook — a mid-run program change,
+    hvd.join(), process-set add/remove, an autotune fusion-threshold
+    update — each logging a cache flush while every asserted result
+    stays exactly correct on both ranks."""
+    import re
+
+    out = _launch("cache", timeout=300.0)
+    for rank in (0, 1):
+        for marker in ("CACHE_STEADY_OK", "CACHE_CHANGE_OK",
+                       "CACHE_JOIN_OK", "CACHE_PSETS_OK",
+                       "CACHE_TUNE_OK", "CACHE_OK"):
+            assert f"{marker} rank={rank}" in out, (marker, out)
+    # Each invalidation hook logged its flush.
+    assert "[hvd-cache]" in out, out
+    assert "program change" in out, out
+    assert "hvd.join()" in out, out
+    assert "membership change" in out, out
+    assert "fusion plans flushed" in out, out
+    # The steady state served from cache on the controller AND the
+    # worker replica.
+    hits = [int(m.group(1)) for m in
+            re.finditer(r"CACHE_STEADY_OK rank=\d hits=(\d+)", out)]
+    assert len(hits) == 2 and all(h > 0 for h in hits), (hits, out)
+
+
+@pytest.mark.slow
+def test_response_cache_disabled_identical_results():
+    """The same scenario with HVD_TPU_RESPONSE_CACHE=0: every numeric
+    assertion is against exact constants, so this leg passing alongside
+    the cache-on leg proves identical results cache on/off."""
+    out = _launch("cache", extra_env={"HVD_TPU_RESPONSE_CACHE": "0"},
+                  timeout=300.0)
+    for rank in (0, 1):
+        assert f"CACHE_OK rank={rank}" in out, out
+
+
 # basic/mismatch/spmd_train/stall/withdraw/checkpoint/torch_frontend/
 # tf_function (+ timeline) run batched in
 # test_two_process_scenarios_combined; only scenarios that END the group
