@@ -32,6 +32,7 @@ only becomes its own mesh axis when explicitly requested.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
@@ -214,6 +215,100 @@ def make_hybrid_mesh(config: Optional[ParallelConfig] = None,
         mesh_shape, dcn_shape, devices=devs,
         allow_split_physical_axes=True)
     return jax.sharding.Mesh(arr, names)
+
+
+# ---------------------------------------------------------------------------
+# Replica-axis ICI x DCN hierarchy (the eager data plane's view of a
+# multi-slice deployment)
+# ---------------------------------------------------------------------------
+# The eager collective path runs over the flat 1-D replica mesh; on a
+# multi-slice pod that flatness hides a 2-level link topology — chips
+# inside a slice talk over ICI, slices talk over DCN, and DCN is an
+# order of magnitude slower.  A flat psum over the replica axis makes
+# XLA move every byte across DCN n_slices times; the bandwidth-optimal
+# decomposition is psum_scatter over ICI -> psum over DCN (1/ici_size
+# of the bytes) -> all_gather over ICI, optionally quantizing the DCN
+# leg only (cf. EQuARX, arXiv:2506.17615).  This block derives that
+# hierarchy as axis_index_groups over the SAME flat replica axis, so
+# the megakernel executor (ops/megakernel.py) can lower hierarchical
+# collectives without re-meshing anything.
+#
+# Env contract (docs/performance.md):
+#   HVD_TPU_HIERARCHICAL=auto|on|off   auto (default): hierarchical when
+#                                      real multi-slice topology is
+#                                      detected; on: also honor declared
+#                                      virtual slices; off: always flat.
+#   HVD_TPU_VIRTUAL_SLICES=<k>         declare k equal contiguous virtual
+#                                      slices (CPU dryrun meshes / tests
+#                                      / topology overrides).
+HIERARCHICAL_ENV = "HVD_TPU_HIERARCHICAL"
+VIRTUAL_SLICES_ENV = "HVD_TPU_VIRTUAL_SLICES"
+
+
+def hierarchical_mode() -> str:
+    mode = os.environ.get(HIERARCHICAL_ENV, "auto").lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"{HIERARCHICAL_ENV}={mode!r}: expected auto, on or off")
+    return mode
+
+
+@dataclass(frozen=True)
+class ReplicaHierarchy:
+    """ICI x DCN decomposition of a flat replica axis of n devices.
+
+    ``ici_groups``: one group per slice (positions along the replica
+    axis); ``dcn_groups``: one group per in-slice position, pairing the
+    k-th chip of every slice — together they express the two-level
+    reduction as grouped collectives over the unchanged 1-D mesh.
+    """
+
+    n_slices: int
+    ici_size: int
+    ici_groups: Tuple[Tuple[int, ...], ...]
+    dcn_groups: Tuple[Tuple[int, ...], ...]
+
+
+def replica_hierarchy(devices: Sequence) -> Optional[ReplicaHierarchy]:
+    """The ICI x DCN hierarchy of ``devices`` (mesh order), or ``None``
+    when the topology is flat / undecomposable / disabled.
+
+    Real slice membership comes from ``device.slice_index`` (multi-slice
+    runtimes); ``HVD_TPU_VIRTUAL_SLICES`` + ``HVD_TPU_HIERARCHICAL=on``
+    declares contiguous virtual slices for dryrun meshes.  Unequal slice
+    sizes degrade to flat — the grouped collectives need a rectangular
+    decomposition.
+    """
+    mode = hierarchical_mode()
+    if mode == "off":
+        return None
+    n = len(devices)
+    if n < 2:
+        return None
+    slice_ids = [getattr(d, "slice_index", None) for d in devices]
+    by_slice: dict = {}
+    if any(s is not None for s in slice_ids) and len(
+            {s for s in slice_ids if s is not None}) > 1:
+        for pos, sid in enumerate(slice_ids):
+            by_slice.setdefault(sid, []).append(pos)
+    elif mode == "on":
+        k = int(os.environ.get(VIRTUAL_SLICES_ENV, "0") or 0)
+        if k > 1 and n % k == 0:
+            ici = n // k
+            by_slice = {s: list(range(s * ici, (s + 1) * ici))
+                        for s in range(k)}
+    if len(by_slice) < 2:
+        return None
+    sizes = {len(g) for g in by_slice.values()}
+    if len(sizes) != 1:
+        return None  # ragged slices: no rectangular decomposition
+    ici_groups = tuple(tuple(by_slice[s]) for s in sorted(by_slice))
+    ici = len(ici_groups[0])
+    dcn_groups = tuple(tuple(g[i] for g in ici_groups)
+                       for i in range(ici))
+    return ReplicaHierarchy(
+        n_slices=len(ici_groups), ici_size=ici,
+        ici_groups=ici_groups, dcn_groups=dcn_groups)
 
 
 def axis_size(axis: str) -> int:

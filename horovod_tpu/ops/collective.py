@@ -54,6 +54,7 @@ from ..analysis import program as _program
 from ..core import compat as _compat
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
+from . import megakernel as _megakernel
 from . import wire
 from .wire import ReduceOp, Request, RequestType, Response, ResponseType
 
@@ -179,6 +180,11 @@ class _Contribution:
     value: Any                        # canonical device array
     ragged: bool = False              # list input with differing dim-0
     orig_sizes: List[int] = field(default_factory=list)
+    # True when ``value`` is a buffer the executor itself materialized
+    # (host input converted by jnp.asarray / an _on_mesh copy) and the
+    # caller can never observe again: the megakernel donates exactly
+    # these (ops/megakernel.py) — user-held jax.Arrays are never donated.
+    owned: bool = False
 
 
 def _wire_device(x) -> int:
@@ -286,7 +292,8 @@ def _classify(x, op: RequestType, ps=None) -> _Contribution:
         return _Contribution(
             per_replica=False, shapes=[payload] * k, dtype=xa.dtype,
             devices=[_wire_device(xa)] * k, value=xa,
-            orig_sizes=[payload[0] if payload else 0] * k)
+            orig_sizes=[payload[0] if payload else 0] * k,
+            owned=xa is not x)
     if st.multiprocess:
         # Reference layout: each process contributes exactly its own local
         # tensor (one MPI rank per process); the coordinator learns the
@@ -301,7 +308,8 @@ def _classify(x, op: RequestType, ps=None) -> _Contribution:
         return _Contribution(
             per_replica=True, shapes=[payload], dtype=xa.dtype,
             devices=[_wire_device(xa)], value=xa,
-            orig_sizes=[payload[0] if payload else 0])
+            orig_sizes=[payload[0] if payload else 0],
+            owned=xa is not x)
     if isinstance(x, (list, tuple)) and op == RequestType.ALLGATHER:
         if len(x) != size:
             raise ValueError(
@@ -323,12 +331,14 @@ def _classify(x, op: RequestType, ps=None) -> _Contribution:
         return _Contribution(
             per_replica=True, shapes=[payload] * size, dtype=xa.dtype,
             devices=[d.id for d in st.devices],
-            value=xa, orig_sizes=[payload[0] if payload else 0] * size)
+            value=xa, orig_sizes=[payload[0] if payload else 0] * size,
+            owned=xa is not x)
     payload = tuple(xa.shape)
     return _Contribution(
         per_replica=False, shapes=[payload] * size, dtype=xa.dtype,
         devices=[dev] * size, value=xa,
-        orig_sizes=[payload[0] if payload else 0] * size)
+        orig_sizes=[payload[0] if payload else 0] * size,
+        owned=xa is not x)
 
 
 # ---------------------------------------------------------------------------
@@ -496,6 +506,19 @@ def _build_kernels(mesh):
             return fn(jnp.squeeze(x, axis=0))[None]
         return body
 
+    def _fold_avg(fn):
+        # AVERAGE's post-reduce divide folded INTO the compiled kernel
+        # (one launch, not reduce + a separate eager _divide dispatch);
+        # integer dtypes floor-divide exactly like _divide.  The mesh
+        # extent n == the averaging denominator by construction (global
+        # mesh: st.size; process-set sub-mesh: the set size).
+        def body(x):
+            out = fn(x)
+            if jnp.issubdtype(out.dtype, jnp.inexact):
+                return out / n
+            return out // n
+        return body
+
     extra = {}
     for key, fn in (("pmin", lambda x: jax.lax.pmin(x, REPLICA_AXIS)),
                     ("pmax", lambda x: jax.lax.pmax(x, REPLICA_AXIS)),
@@ -514,17 +537,36 @@ def _build_kernels(mesh):
             lambda x: _adasum(jnp.squeeze(x, axis=0)),
             P(REPLICA_AXIS), P(), check_vma=False)
 
+    _psum = lambda x: jax.lax.psum(x, REPLICA_AXIS)  # noqa: E731
+
     return {
         **extra,
         # Per-replica [size, ...] -> per-replica [size, ...] (each = sum).
-        "psum_pr": sm(lambda x: jax.lax.psum(x, REPLICA_AXIS),
-                      P(REPLICA_AXIS), P(REPLICA_AXIS)),
+        "psum_pr": sm(_psum, P(REPLICA_AXIS), P(REPLICA_AXIS)),
         # Replicated [...] -> replicated [...] (= x * size, honest
         # collective).
-        "psum_rep": sm(lambda x: jax.lax.psum(x, REPLICA_AXIS), P(), P()),
+        "psum_rep": sm(_psum, P(), P()),
         # Per-replica [size, ...] -> replicated [...] (sum of shards).
         "psum_out_rep": sm(_psum_squeeze_block, P(REPLICA_AXIS), P(),
                            check_vma=False),
+        # AVERAGE variants: the mean's divide folded into the compiled
+        # program — no separate eager _divide launch after the
+        # collective (the data-plane megakernel work, docs/tensor-fusion.md).
+        "psum_pr_avg": sm(_fold_avg(_psum), P(REPLICA_AXIS),
+                          P(REPLICA_AXIS)),
+        "psum_rep_avg": sm(_fold_avg(_psum), P(), P()),
+        "psum_out_rep_avg": sm(_fold_avg(_psum_squeeze_block),
+                               P(REPLICA_AXIS), P(), check_vma=False),
+        "rscatter_pr_avg": sm(_fold_avg(_rscatter_pr_block),
+                              P(REPLICA_AXIS), P(REPLICA_AXIS),
+                              check_vma=False),
+        "rscatter_rep_avg": sm(_fold_avg(_rscatter_rep_block), P(),
+                               P(REPLICA_AXIS), check_vma=False),
+        # Replicated-input broadcast: the identity-with-execution-parity
+        # psum(x)/n collapsed into one compiled program (inexact dtypes
+        # only; integer replicated broadcasts stay the pure identity).
+        "bcast_rep": sm(lambda x: jax.lax.psum(x, REPLICA_AXIS) / n,
+                        P(), P()),
         # Per-replica [size, d0, ...] -> replicated [size*d0, ...].
         "gather_pr": sm(_gather_block, P(REPLICA_AXIS), P(),
                         check_vma=False),
@@ -638,6 +680,188 @@ def _divide(x, denom: int):
     if jnp.issubdtype(x.dtype, jnp.inexact):
         return x / denom
     return x // denom
+
+
+# ---------------------------------------------------------------------------
+# Megakernel launches (ops/megakernel.py): one donated pack→reduce→unpack
+# executable per fusion group instead of the per-tensor eager choreography
+# ---------------------------------------------------------------------------
+
+def _group_digest_fn(group: List["_QueuedOp"], psid: int):
+    """Lazy fusion-plan digest of one response group — the PR 2 cycle
+    digest (ops/cache.cycle_digest scheme) the compiled executable is
+    recorded under; only evaluated on a cold compile."""
+    def digest() -> str:
+        entries = [_program.SignatureEntry(
+            seq=0, op=o.op.name.lower(), name=o.name,
+            dtype=wire.dtype_name(wire.dtype_of(o.contrib.dtype)),
+            shape=tuple(o.contrib.shapes[0]),
+            reduce_op=wire.reduce_op_name(o.red_op),
+            process_set_id=psid) for o in group]
+        return _megakernel.plan_digest(entries)
+    return digest
+
+
+def _megakernel_eligible(group: List["_QueuedOp"]) -> bool:
+    return (_megakernel.enabled()
+            and group[0].red_op != ReduceOp.ADASUM)
+
+
+def _tl_group_start(tl, group: List["_QueuedOp"]) -> None:
+    for o in group:
+        _tl_start(tl, o, "ALLREDUCE")
+        tl.activity_start(o.name, "FUSED_KERNEL")
+
+
+def _tl_group_end(tl, group: List["_QueuedOp"], hier) -> None:
+    for o in group:
+        tl.activity_end(o.name)
+        if hier is not None:
+            tl.instant(o.name, "DCN_ALLREDUCE", args={
+                "slices": hier.topo.n_slices, "ici": hier.topo.ici_size,
+                "wire_dtype": hier.wire_dtype or str(o.contrib.dtype)})
+        tl.end(o.name, dtype=str(o.contrib.dtype))
+
+
+def _launch_group_megakernel(group: List["_QueuedOp"], layout: bool,
+                             denom: int, ps, mesh, tl, hm) -> bool:
+    """Single-process fused-group launch: ONE jitted donated executable
+    packs the group, reduces once (hierarchically on multi-slice
+    meshes), folds the AVERAGE divide and unpacks — exactly one XLA
+    dispatch per fusion group.  Returns False to fall back to the
+    per-tensor eager path (unbuildable spec)."""
+    o0 = group[0]
+    op_kernel = _OP_KERNEL[o0.red_op]
+    mesh_key = tuple(mesh.devices.flat)
+    spec = _megakernel.GroupSpec(
+        mesh_key=mesh_key, variant="sp_pr" if layout else "sp_rep",
+        op=op_kernel, average=o0.red_op == ReduceOp.AVERAGE, denom=denom,
+        dtype=jnp.dtype(o0.contrib.dtype).name,
+        shapes=tuple(tuple(o.contrib.shapes[0]) for o in group),
+        donate=tuple(bool(o.contrib.owned) for o in group),
+        hier=_megakernel.hierarchy_for(mesh_key, op_kernel,
+                                       o0.contrib.dtype))
+    values = [o.contrib.value for o in group]
+    psid = 0 if ps is None else ps.process_set_id
+    if tl: _tl_group_start(tl, group)
+    try:
+        outs = _megakernel.launch(spec, mesh, values,
+                                  digest_fn=_group_digest_fn(group, psid))
+    except Exception as e:  # noqa: BLE001 — unbuildable spec
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        if tl:
+            for o in group:
+                tl.activity_end(o.name)
+                tl.end(o.name, dtype=str(o.contrib.dtype))
+        if not any(d and isinstance(v, jax.Array) and v.is_deleted()
+                   for v, d in zip(values, spec.donate)):
+            return False  # inputs intact: per-tensor eager fallback
+        # A RUNTIME failure after XLA already consumed the donated
+        # inputs (trace/compile errors leave them intact): an eager
+        # retry would read deleted buffers — fail the group loudly at
+        # synchronize instead (mirrors _launch_mp_megakernel).
+        err = HorovodError(
+            f"megakernel launch failed after its inputs were donated "
+            f"({type(e).__name__}: {e}); the group cannot fall back to "
+            f"the per-tensor path.")
+        for o in group:
+            hm._get(o.handle).result = err
+        return True
+    for o, out in zip(group, outs):
+        # Donated (or simply consumed) input: nothing may read it after
+        # dispatch — drop the reference so use-after-donate is
+        # impossible by construction (tests/test_megakernel.py probes
+        # this with weakrefs).
+        o.contrib.value = None
+        hm._get(o.handle).result = out
+    if tl: _tl_group_end(tl, group, spec.hier)
+    return True
+
+
+def _launch_mp_megakernel(resp: Response, ops: List["_QueuedOp"], ps,
+                          mesh, denom: int, tl, hm) -> bool:
+    """Multi-process fused-group launch: one jitted local pack (donating
+    executor-owned contributions) → one donated reduce+divide+unpack
+    executable over the process mesh.  Handles the joined-rank case
+    transparently: ``resp`` names tensors this rank never submitted —
+    they contribute zeros and their outputs are discarded, exactly like
+    the peers' buffer."""
+    st = _state.global_state()
+    by_name = {o.name: o for o in ops}
+    dtype = (jnp.dtype(ops[0].contrib.dtype) if ops
+             else jnp.dtype(wire.np_dtype_of(resp.tensor_type)))
+    shapes = []
+    values = []
+    donate = []
+    for pos, name in enumerate(resp.tensor_names):
+        o = by_name.get(name)
+        if o is not None:
+            shapes.append(tuple(o.contrib.shapes[0]))
+            values.append(o.contrib.value)
+            donate.append(bool(o.contrib.owned))
+        else:
+            shp = (tuple(resp.tensor_shapes[pos])
+                   if pos < len(resp.tensor_shapes)
+                   else tuple(resp.tensor_shapes[0]))
+            shapes.append(shp)
+            values.append(jnp.zeros(shp, dtype))  # joined: zero slot
+            donate.append(True)
+    avg = ((ops[0].red_op if ops else resp.reduce_op)
+           == ReduceOp.AVERAGE)
+    op_kernel = _OP_KERNEL[ops[0].red_op if ops else resp.reduce_op]
+    mesh_key = tuple(mesh.devices.flat)
+    spec = _megakernel.GroupSpec(
+        mesh_key=mesh_key, variant="mp", op=op_kernel, average=avg,
+        denom=denom, dtype=dtype.name, shapes=tuple(shapes),
+        donate=(True,),  # the packed buffer is always executor-owned
+        hier=_megakernel.hierarchy_for(mesh_key, op_kernel, dtype))
+    group = [by_name[n] for n in resp.tensor_names if n in by_name]
+    if tl: _tl_group_start(tl, group)
+    consumed = False
+    try:
+        pack = _megakernel.packer(tuple(shapes), dtype.name,
+                                  tuple(donate), mesh_key)
+        flat = pack(*values)
+        # Fallback is only off the table if the pack REALLY donated a
+        # contribution the eager path would need (mirrors the
+        # is_deleted probe of _launch_group_megakernel; all-user-held
+        # groups donate nothing and stay recoverable).
+        consumed = any(d and isinstance(v, jax.Array) and v.is_deleted()
+                       for v, d in zip(values, donate))
+        buf = _mp_global(flat, ps)
+        psid = 0 if ps is None else ps.process_set_id
+        outs = _megakernel.launch(spec, mesh, [buf],
+                                  digest_fn=_group_digest_fn(group, psid)
+                                  if group else None)
+    except Exception as e:  # noqa: BLE001 — unbuildable spec
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        if tl:
+            for o in group:
+                tl.activity_end(o.name)
+                tl.end(o.name, dtype=str(o.contrib.dtype))
+        if not consumed:
+            return False  # inputs intact: per-tensor eager fallback
+        # The pack already donated the executor-owned inputs; an eager
+        # retry would read deleted buffers.  Fail the group loudly at
+        # synchronize instead of silently wedging it.
+        err = HorovodError(
+            f"megakernel launch failed after the fusion buffer was "
+            f"packed ({type(e).__name__}: {e}); the group cannot fall "
+            f"back to the per-tensor path.")
+        for o in group:
+            hm._get(o.handle).result = err
+        return True
+    for name, out in zip(resp.tensor_names, outs):
+        o = by_name.get(name)
+        if o is not None:
+            o.contrib.value = None  # consumed: see _launch_group_megakernel
+            hm._get(o.handle).result = out
+    if tl: _tl_group_end(tl, group, spec.hier)
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -845,6 +1069,7 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
 
     if resp.response_type == ResponseType.ALLREDUCE:
         ks = _mesh_kernels() if ps is None else ps.mesh_and_kernels()[1]
+        mesh = st.mesh if ps is None else ps.mesh_and_kernels()[0]
         # Sub-group by layout: per-replica vs replicated inputs reduce with
         # different shardings and cannot share one flat buffer.  The group
         # is homogeneous in red_op (the coordinator fuses like-op only).
@@ -852,15 +1077,29 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
             group = [o for o in ops if o.contrib.per_replica == layout]
             if not group:
                 continue
+            # Megakernel path (default): one donated pack→reduce→unpack
+            # executable per fusion group — a single XLA dispatch, with
+            # the AVERAGE divide folded in and a hierarchical ICI×DCN
+            # reduction on multi-slice meshes (ops/megakernel.py).
+            if _megakernel_eligible(group) and _launch_group_megakernel(
+                    group, layout, denom, ps, mesh, tl, hm):
+                continue
+            # Eager fallback (HVD_TPU_MEGAKERNEL=0): the per-tensor
+            # choreography — also the bench's comparison baseline.
+            avg = group[0].red_op == ReduceOp.AVERAGE
             kernel = ks[_OP_KERNEL[group[0].red_op]
                         + ("_pr" if layout else "_rep")]
             if len(group) == 1:
                 o = group[0]
                 if tl: _tl_start(tl, o, "ALLREDUCE")
                 if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
-                out = kernel(o.contrib.value)
-                if o.red_op == ReduceOp.AVERAGE:
-                    out = _divide(out, denom)
+                if avg:
+                    # Single-tensor AVERAGE: divide folded into the
+                    # compiled kernel, not a separate eager dispatch.
+                    out = ks["psum_pr_avg" if layout
+                             else "psum_rep_avg"](o.contrib.value)
+                else:
+                    out = kernel(o.contrib.value)
                 if tl: tl.activity_end(o.name)
                 if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
                 hm._get(o.handle).result = out
@@ -964,11 +1203,12 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
         for o in ops:  # never fused: each op owns its chunk layout
             if tl: _tl_start(tl, o, "REDUCESCATTER")
             if tl: tl.activity_start(o.name, "XLA_REDUCESCATTER")
-            kernel = ks["rscatter_pr" if o.contrib.per_replica
-                        else "rscatter_rep"]
+            # AVERAGE folds its divide into the compiled kernel — one
+            # launch instead of reduce + a separate eager _divide.
+            avg = "_avg" if o.red_op == ReduceOp.AVERAGE else ""
+            kernel = ks[("rscatter_pr" if o.contrib.per_replica
+                         else "rscatter_rep") + avg]
             out = kernel(o.contrib.value)
-            if o.red_op == ReduceOp.AVERAGE:
-                out = _divide(out, denom)
             if tl: tl.activity_end(o.name)
             if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
             hm._get(o.handle).result = out
@@ -981,28 +1221,52 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
             if tl: _tl_start(tl, o, "ALLGATHER")
             if tl: tl.activity_start(o.name, "XLA_ALLGATHER")
             if c.ragged or isinstance(c.value, list):
-                sizes = resp.tensor_sizes or c.orig_sizes
+                sizes = list(resp.tensor_sizes or c.orig_sizes)
                 dmax = max(sizes)
-                rest = c.shapes[0][1:]
-                padded = jnp.stack([
-                    jnp.concatenate([
-                        v, jnp.zeros((dmax - v.shape[0],) + rest, v.dtype)
-                    ], axis=0) if v.shape[0] < dmax else v
-                    for v in c.value])
-                if ps is None:
-                    padded = shard(padded)
+                rest = tuple(c.shapes[0][1:])
+                total = int(sum(sizes))
+                k = len(c.value)
+                if total == 0 or dmax == 0:
+                    out = jnp.zeros((0,) + rest, c.dtype)
                 else:
-                    mesh_ps, _ = ps.mesh_and_kernels()
-                    spec = [None] * padded.ndim
-                    spec[0] = REPLICA_AXIS
-                    padded = jax.device_put(
-                        padded, NamedSharding(mesh_ps, P(*spec)))
-                gathered = ks["gather_pr"](padded)  # [size*dmax, rest...]
-                def _unpad(g, sizes=tuple(sizes), dmax=dmax):
-                    pieces = [g[i * dmax:i * dmax + s]
-                              for i, s in enumerate(sizes)]
-                    return jnp.concatenate(pieces, axis=0)
-                out = _unpad(gathered)
+                    # Vectorized pad/stack (round-4 alltoall treatment
+                    # applied here): the padded [k, dmax, rest] staging
+                    # buffer is built with ONE device-side gather over
+                    # the concatenated contributions instead of a
+                    # per-tensor host loop of jnp.concatenate zero-pads
+                    # — the O(k) eager-dispatch chain becomes 2
+                    # launches.  The index plan is host-side int32;
+                    # clamped duplicate rows stand in for the zero
+                    # padding (both are sliced off by the unpad below,
+                    # so the values never surface).
+                    sz = np.asarray(sizes, np.int64)
+                    starts = np.zeros(k, np.int64)
+                    starts[1:] = np.cumsum(sz)[:-1]
+                    j = np.arange(dmax)
+                    gather_idx = starts[:, None] + np.minimum(
+                        j[None, :], np.maximum(sz[:, None] - 1, 0))
+                    gather_idx = np.clip(gather_idx, 0,
+                                         total - 1).astype(np.int32)
+                    flat = jnp.concatenate(
+                        [jnp.asarray(v) for v in c.value], axis=0)
+                    padded = jnp.take(flat, jnp.asarray(gather_idx),
+                                      axis=0)  # [k, dmax, rest...]
+                    if ps is None:
+                        padded = shard(padded)
+                    else:
+                        mesh_ps, _ = ps.mesh_and_kernels()
+                        spec = [None] * padded.ndim
+                        spec[0] = REPLICA_AXIS
+                        padded = jax.device_put(
+                            padded, NamedSharding(mesh_ps, P(*spec)))
+                    gathered = ks["gather_pr"](padded)  # [k*dmax, ...]
+                    # Unpad with one gather too: row plan of each
+                    # rank's first s_i rows, in rank order.
+                    unpad_idx = np.concatenate(
+                        [i * dmax + np.arange(s)
+                         for i, s in enumerate(sizes)]).astype(np.int32)
+                    out = jnp.take(gathered, jnp.asarray(unpad_idx),
+                                   axis=0)
             elif c.per_replica:
                 out = ks["gather_pr"](c.value)
             else:
@@ -1023,8 +1287,10 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
             else:
                 # Replicated input: broadcast is the identity, but still run
                 # a collective for execution parity with the reference's
-                # unconditional MPI_Bcast (operations.cc:1053-1055).
-                out = ks["psum_rep"](c.value) / denom \
+                # unconditional MPI_Bcast (operations.cc:1053-1055) —
+                # psum(x)/n compiled as ONE kernel, not psum + an eager
+                # divide launch.
+                out = ks["bcast_rep"](c.value) \
                     if jnp.issubdtype(c.value.dtype, jnp.inexact) \
                     else c.value
             if tl: tl.activity_end(o.name)
@@ -1081,21 +1347,35 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
         return
 
     if resp.response_type == ResponseType.ALLREDUCE:
+        mesh = (_mp_kernels()[0] if ps is None
+                else ps.mesh_and_kernels()[0])
+        # Megakernel path (default): one jitted local pack → one donated
+        # reduce+divide+unpack executable over the process mesh
+        # (ops/megakernel.py) instead of the per-tensor slice/divide
+        # chain below.
+        if _megakernel_eligible(ops) and _launch_mp_megakernel(
+                resp, ops, ps, mesh, denom, tl, hm):
+            return
         if len(ops) == 1:
             o = ops[0]
             if tl: _tl_start(tl, o, "ALLREDUCE")
             if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
-            out = ks[_OP_KERNEL[o.red_op] + "_out_rep"](
-                _mp_global(o.contrib.value, ps))
             if o.red_op == ReduceOp.AVERAGE:
-                out = _divide(out, denom)
+                # Divide folded into the compiled kernel, not a
+                # separate eager dispatch after it.
+                out = ks["psum_out_rep_avg"](
+                    _mp_global(o.contrib.value, ps))
+            else:
+                out = ks[_OP_KERNEL[o.red_op] + "_out_rep"](
+                    _mp_global(o.contrib.value, ps))
             if tl: tl.activity_end(o.name)
             if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
             hm._get(o.handle).result = out
             return
-        # Fused: one flat buffer per response (≙ MEMCPY_IN_FUSION_BUFFER).
-        # Homogeneous in red_op — the coordinator fuses like-op only (and
-        # never fuses adasum, whose dots are per-tensor).
+        # Fused eager fallback (HVD_TPU_MEGAKERNEL=0): one flat buffer
+        # per response (≙ MEMCPY_IN_FUSION_BUFFER).  Homogeneous in
+        # red_op — the coordinator fuses like-op only (and never fuses
+        # adasum, whose dots are per-tensor).
         for o in ops:
             if tl: _tl_start(tl, o, "ALLREDUCE")
             if tl: tl.activity_start(o.name, "MEMCPY_IN_FUSION_BUFFER")
@@ -1152,13 +1432,15 @@ def _execute_response_mp(resp: Response, ops: List[_QueuedOp]) -> None:
         for o in ops:
             if tl: _tl_start(tl, o, "REDUCESCATTER")
             if tl: tl.activity_start(o.name, "XLA_REDUCESCATTER")
-            res = ks["rscatter_pr"](_mp_global(o.contrib.value, ps))
+            # AVERAGE folds its divide into the compiled kernel (no
+            # separate eager dispatch on the extracted chunk).
+            kernel = ks["rscatter_pr_avg"
+                        if o.red_op == ReduceOp.AVERAGE else "rscatter_pr"]
+            res = kernel(_mp_global(o.contrib.value, ps))
             # This process's chunk: its addressable row of the P(A)
             # output (Horovod returns only the caller's chunk).
             mine = jnp.squeeze(jnp.asarray(res.addressable_data(0)),
                                axis=0)
-            if o.red_op == ReduceOp.AVERAGE:
-                mine = _divide(mine, denom)
             if tl: tl.activity_end(o.name)
             if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
             hm._get(o.handle).result = mine
@@ -1219,6 +1501,17 @@ def _execute_response_mp_joined(resp: Response,
     by_name = {o.name: o for o in ops}
 
     if resp.response_type == ResponseType.ALLREDUCE:
+        # Megakernel path: the zero-contribution slots are packed into
+        # the identical fused program the live ranks run —
+        # _launch_mp_megakernel fills zeros for tensors this rank never
+        # submitted and discards their outputs.
+        if (_megakernel.enabled()
+                and (not ops or ops[0].red_op != ReduceOp.ADASUM)
+                and _launch_mp_megakernel(
+                    resp, ops, None, _mp_kernels()[0],
+                    st.process_count, st.timeline, hm)):
+            return
+
         def numel(s):
             return int(np.prod(s, dtype=np.int64)) if s else 1
 

@@ -103,3 +103,33 @@ class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+
+
+def resolve(name: str):
+    """Compressor by env-style name (``none``/``fp16``/``bf16``) — the
+    lookup behind ``HVD_TPU_DCN_COMPRESS`` (the hierarchical-allreduce
+    DCN-leg compressor, ops/megakernel.py) and any other string-keyed
+    configuration surface."""
+    try:
+        return getattr(Compression, name.strip().lower())
+    except AttributeError:
+        raise ValueError(
+            f"unknown compressor {name!r}: expected one of "
+            f"none, fp16, bf16") from None
+
+
+def wire_dtype_for(name: str, dtype):
+    """The narrowed wire dtype ``name`` implies for tensors of
+    ``dtype``, or ``None`` when compression does not apply (identity
+    compressor, non-float payloads, already-narrow floats) — the same
+    applicability rule as :meth:`_CastCompressor.compress`, decidable
+    from the dtype alone so jitted kernels can fold the casts at trace
+    time."""
+    comp = resolve(name)
+    wire = getattr(comp, "wire_dtype", None)
+    if wire is None:
+        return None
+    if (jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+            and jnp.dtype(dtype).itemsize > jnp.dtype(wire).itemsize):
+        return wire
+    return None

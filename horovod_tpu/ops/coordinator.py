@@ -706,6 +706,14 @@ class Coordinator:
             # Entries stay valid (the negotiated outcome is threshold-
             # independent) but every memoized packing plan is stale.
             self.cache.invalidate_plans(f"fusion threshold -> {v}")
+        # The compiled megakernels are keyed by group STRUCTURE, which a
+        # re-partitioned threshold changes wholesale — drop them with
+        # the plan memo instead of aging stale executables out (lazy
+        # import: megakernel pulls in jax kernels this control-plane
+        # module otherwise never needs).
+        from . import megakernel as _megakernel
+
+        _megakernel.flush(f"fusion threshold -> {v}")
 
     def poll_responses(self, sizes_bytes: Dict[str, int]) -> List[Response]:
         now = time.monotonic()

@@ -1,0 +1,440 @@
+"""Fused data-plane megakernels for the eager collective executor.
+
+PR 2 deleted the steady state's control-plane cost (response cache +
+replayed fusion plans); what remained of the per-step tax was data-plane
+dispatch: ``ops/collective._execute_response`` surrounded each jitted
+collective with a Python loop of *eager* XLA dispatches — a
+``jnp.concatenate`` pack, per-tensor slice/reshape unpacks, a separate
+divide launch for AVERAGE — with no buffer donation and no executable
+reuse tied to the cached plans.  That is exactly the fusion-buffer copy
+overhead the original Horovod paper identifies as the small-tensor
+scaling wall (arXiv:1802.05799 §4), re-materialized as host dispatch
+latency instead of memcpy bandwidth.
+
+This module replaces that choreography with **one jitted, donated
+megakernel per fusion group**: a shape/dtype/layout/reduce-op/mesh-keyed
+executable that packs the group's tensors into a flat fusion buffer,
+runs the collective once, folds the AVERAGE divide in, and unpacks to
+the result tensors *inside a single XLA program* — the compiler fuses
+the copies into the collective and the drain thread performs exactly
+one dispatch per group (asserted by tests/test_megakernel.py via
+utils/xla_dispatch.py).  ``donate_argnums`` covers every input buffer
+the executor itself owns (host-converted contributions, the packed
+multi-process fusion buffer), so the steady state stops allocating; the
+user's own arrays are never donated.
+
+Compiled executables are cached per group structure and recorded under
+the fusion-plan digest of the PR 2 response cache
+(``ops/cache.py:plan_fusion`` / ``cycle_digest``), so a replayed cycle
+goes straight from ``FRAME_RESPONSE_BATCH`` to a pre-compiled launch.
+The cache is bounded and flushed through the same plan-memo
+invalidation hook as the memoized fusion plans
+(``Coordinator.set_fusion_threshold`` → :func:`flush`).
+
+On multi-slice DCN deployments (``core/topology.replica_hierarchy``)
+the ALLREDUCE reduction is lowered hierarchically — ``psum_scatter``
+over ICI → ``psum`` over DCN → ``all_gather`` over ICI — which moves
+``1/ici_size`` of the bytes over the slow DCN leg, optionally narrowed
+to bf16/fp16 on that leg only (``HVD_TPU_DCN_COMPRESS``, reusing
+ops/compression.py; cf. EQuARX, arXiv:2506.17615).
+
+Env contract (docs/performance.md):
+  HVD_TPU_MEGAKERNEL=0           fall back to the per-tensor eager
+                                 executor (default on; the bench's
+                                 comparison baseline)
+  HVD_TPU_HIERARCHICAL=auto|on|off   see core/topology.py
+  HVD_TPU_VIRTUAL_SLICES=<k>         see core/topology.py
+  HVD_TPU_DCN_COMPRESS=none|bf16|fp16  DCN-leg wire dtype (default none)
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+import sys
+import weakref
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..analysis import lockorder as _lockorder
+from ..analysis import program as _program
+from ..core import compat as _compat
+from ..core import topology as _topology
+from ..core.state import REPLICA_AXIS
+from ..utils import xla_dispatch as _xla_dispatch
+from . import compression as _compression
+from .wire import ReduceOp
+
+# Compiled-executable cache bound: a stable program needs one entry per
+# (fusion group structure x mesh); jittery tick partitioning can mint a
+# few orders, never hundreds — overflow means churn, so clear wholesale
+# like the fusion-plan memo (ops/cache.py take_ready).
+CACHE_CAPACITY = 128
+
+DCN_COMPRESS_ENV = "HVD_TPU_DCN_COMPRESS"
+
+_enabled_override: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """Megakernel executor gate (default on); ``set_enabled`` overrides
+    the env for in-process A/B runs (bench, tests)."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("HVD_TPU_MEGAKERNEL", "1") != "0"
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Force the executor on/off (``None`` restores the env gate)."""
+    global _enabled_override
+    _enabled_override = value
+
+
+# Reduce-op kernel families the megakernel can lower.  ADASUM is absent
+# by design: its per-tensor dot products are scale adaptations that the
+# coordinator never fuses (ops/cache.plan_fusion) and that need the
+# ladder/VHDD kernels of ops/collective.py.
+_OPS = ("psum", "pmin", "pmax", "pprod")
+
+
+@dataclass(frozen=True)
+class Hierarchy:
+    """Static hierarchical-reduction parameters baked into a kernel:
+    the topology's ICI×DCN decomposition plus the DCN-leg wire dtype
+    (None = uncompressed)."""
+
+    topo: _topology.ReplicaHierarchy
+    wire_dtype: Optional[str]
+
+
+@dataclass(frozen=True)
+class GroupSpec:
+    """Cache key of one fused-group executable: everything that changes
+    the traced program.  ``mesh_key`` is the tuple of jax Device
+    OBJECTS (the same convention as ops/collective._kernels: a
+    restarted backend's fresh devices miss naturally)."""
+
+    mesh_key: Tuple[Any, ...]
+    variant: str          # "sp_pr" | "sp_rep" | "mp"
+    op: str               # _OPS member
+    average: bool
+    denom: int
+    dtype: str
+    shapes: Tuple[Tuple[int, ...], ...]
+    donate: Tuple[bool, ...]
+    hier: Optional[Hierarchy] = None
+
+
+@dataclass
+class MegakernelStats:
+    builds: int = 0
+    cache_hits: int = 0
+    flushes: int = 0
+    launches: int = 0
+    # XLA executable launches observed DURING megakernel launches (only
+    # populated under HVD_TPU_COUNT_DISPATCHES=1): the dispatch-count
+    # regression contract is launch_dispatches == launches — exactly one
+    # executable per fusion group, no eager-op creep.
+    launch_dispatches: int = 0
+    hier_launches: int = 0
+    donated_inputs: int = 0
+
+
+stats = MegakernelStats()
+
+_lock = _lockorder.make_lock("megakernel._lock")
+_compiled: Dict[GroupSpec, Callable] = {}  # guarded_by: _lock
+_digests: Dict[GroupSpec, str] = {}  # guarded_by: _lock
+_by_digest: Dict[str, GroupSpec] = {}  # guarded_by: _lock
+# Donation-safety probes (tests): weakrefs of the inputs donated by the
+# most recent launch — after the launch nothing in the runtime may hold
+# them, so post-gc the refs must be dead.  Only recorded while dispatch
+# counting is on; production launches skip the bookkeeping.
+last_donated: List[weakref.ref] = []
+
+
+def dcn_compress_name() -> str:
+    return os.environ.get(DCN_COMPRESS_ENV, "none")
+
+
+def flush(reason: str) -> None:
+    """Drop every compiled executable (the plan-memo invalidation hook:
+    fusion-threshold changes re-partition groups, so the old structures
+    go cold — reclaim them instead of aging them out)."""
+    with _lock:
+        n = len(_compiled)
+        _compiled.clear()
+        _digests.clear()
+        _by_digest.clear()
+        stats.flushes += 1
+    if n:
+        print(f"[hvd-megakernel] cache flushed ({reason}): "
+              f"{n} executables dropped", file=sys.stderr)
+
+
+def cache_size() -> int:
+    with _lock:
+        return len(_compiled)
+
+
+def digest_of(spec: GroupSpec) -> Optional[str]:
+    """Fusion-plan digest a compiled spec was recorded under (tests)."""
+    with _lock:
+        return _digests.get(spec)
+
+
+def spec_for_digest(digest: str) -> Optional[GroupSpec]:
+    """Reverse lookup: the compiled group keyed by a plan digest — how
+    bench/tests prove a replayed cycle lands on a pre-compiled
+    executable."""
+    with _lock:
+        return _by_digest.get(digest)
+
+
+def plan_digest(entries: Sequence[_program.SignatureEntry]) -> str:
+    """The PR 2 fusion-plan digest of a group's signature entries
+    (analysis/program.py's canonical scheme, shared with
+    ops/cache.cycle_digest so cache diagnostics and executable records
+    name a cycle identically)."""
+    return _program.entries_digest(list(entries))
+
+
+@functools.lru_cache(maxsize=64)
+def _hierarchy_cached(mesh_key: Tuple, dtype: str, mode: str,
+                      virtual: str, compress: str) -> Optional[Hierarchy]:
+    # The env values are part of the key, so this memo needs no
+    # invalidation: a changed knob is a different key (the O(n) device
+    # scan + group-tuple construction runs once per configuration, not
+    # once per fusion-group launch on the steady-state hot path).
+    h = _topology.replica_hierarchy(mesh_key)
+    if h is None:
+        return None
+    wire = _compression.wire_dtype_for(compress, jnp.dtype(dtype))
+    return Hierarchy(
+        topo=h,
+        wire_dtype=(jnp.dtype(wire).name if wire is not None else None))
+
+
+def hierarchy_for(mesh_devices: Tuple, op: str,
+                  dtype) -> Optional[Hierarchy]:
+    """The hierarchical-reduction plan for one group, or None for flat.
+
+    Only the psum family decomposes (SUM/AVERAGE — the gradient path);
+    the DCN wire dtype applies the compression.py applicability rule to
+    the group's dtype at plan time so the kernel folds the casts."""
+    if op != "psum":
+        return None
+    return _hierarchy_cached(
+        tuple(mesh_devices), jnp.dtype(dtype).name,
+        os.environ.get(_topology.HIERARCHICAL_ENV, "auto"),
+        os.environ.get(_topology.VIRTUAL_SLICES_ENV, ""),
+        dcn_compress_name())
+
+
+# ---------------------------------------------------------------------------
+# Kernel bodies
+# ---------------------------------------------------------------------------
+
+def _numel(shape: Tuple[int, ...]) -> int:
+    return int(math.prod(shape)) if shape else 1
+
+
+def _reduce_flat(spec: GroupSpec):
+    """flat [T] local vector -> [T] reduced (replicated across the
+    group's axis) — the collective core of every megakernel."""
+    hier = spec.hier
+
+    def reduce_fn(v):
+        if spec.op == "pmin":
+            return jax.lax.pmin(v, REPLICA_AXIS)
+        if spec.op == "pmax":
+            return jax.lax.pmax(v, REPLICA_AXIS)
+        if spec.op == "pprod":
+            # No lax.pprod exists: gather + local product, like the
+            # per-tensor kernels (XLA fuses the pointwise product into
+            # the gather's consumer).
+            return jnp.prod(
+                jax.lax.all_gather(v, REPLICA_AXIS, axis=0), axis=0)
+        if hier is None:
+            return jax.lax.psum(v, REPLICA_AXIS)
+        # Hierarchical ICI x DCN: scatter-reduce inside the slice, sum
+        # the 1/ici_size fragments across slices (optionally narrowed on
+        # that slow leg only), then re-gather inside the slice.
+        L = v.shape[0]
+        pad = (-L) % hier.topo.ici_size
+        if pad:
+            v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+        ici = [list(g) for g in hier.topo.ici_groups]
+        dcn = [list(g) for g in hier.topo.dcn_groups]
+        s = jax.lax.psum_scatter(v, REPLICA_AXIS, scatter_dimension=0,
+                                 tiled=True, axis_index_groups=ici)
+        if hier.wire_dtype is not None:
+            s = jax.lax.psum(s.astype(jnp.dtype(hier.wire_dtype)),
+                             REPLICA_AXIS,
+                             axis_index_groups=dcn).astype(v.dtype)
+        else:
+            s = jax.lax.psum(s, REPLICA_AXIS, axis_index_groups=dcn)
+        g = jax.lax.all_gather(s, REPLICA_AXIS, axis=0, tiled=True,
+                               axis_index_groups=ici)
+        return g[:L] if pad else g
+
+    return reduce_fn
+
+
+def _unpack(spec: GroupSpec, red, lead: Tuple[int, ...]):
+    """Split the reduced flat buffer back into the group's payload
+    shapes, folding the AVERAGE divide (floor division for integer
+    dtypes — the `_divide` contract of ops/collective.py)."""
+    outs = []
+    offs = 0
+    integral = not jnp.issubdtype(jnp.dtype(spec.dtype), jnp.inexact)
+    for shp in spec.shapes:
+        cnt = _numel(shp)
+        piece = red[..., offs:offs + cnt].reshape(lead + shp)
+        offs += cnt
+        if spec.average:
+            piece = piece // spec.denom if integral else piece / spec.denom
+        outs.append(piece)
+    return tuple(outs)
+
+
+def _build(spec: GroupSpec, mesh) -> Callable:
+    """Trace + wrap one group executable: pack → reduce → unpack in a
+    single XLA program over ``mesh``, donated on the owned inputs."""
+    reduce_fn = _reduce_flat(spec)
+
+    if spec.variant == "sp_pr":
+        # Single-process, per-replica [n, *payload] inputs sharded over
+        # the replica axis; outputs keep the layout (every row = the
+        # reduction, Horovod's allreduce contract).
+        def body(*ts):
+            flat = jnp.concatenate(
+                [t.reshape((t.shape[0], -1)) for t in ts], axis=1)
+            red = reduce_fn(jnp.squeeze(flat, 0))[None]
+            return _unpack(spec, red, (1,))
+
+        in_specs = tuple(P(REPLICA_AXIS) for _ in spec.shapes)
+        out_specs = tuple(P(REPLICA_AXIS) for _ in spec.shapes)
+    elif spec.variant == "sp_rep":
+        # Replicated inputs: every replica contributes the same value;
+        # psum multiplies by the axis size exactly like the honest
+        # per-tensor psum_rep kernel.
+        def body(*ts):
+            flat = jnp.concatenate([t.reshape(-1) for t in ts])
+            red = reduce_fn(flat)
+            return _unpack(spec, red, ())
+
+        in_specs = tuple(P() for _ in spec.shapes)
+        out_specs = tuple(P() for _ in spec.shapes)
+    elif spec.variant == "mp":
+        # Multi-process: one packed [P, T] fusion buffer (each process
+        # contributed its flat shard), replicated payload outputs.
+        def body(buf):
+            red = reduce_fn(jnp.squeeze(buf, 0))
+            return _unpack(spec, red, ())
+
+        in_specs = (P(REPLICA_AXIS),)
+        out_specs = tuple(P() for _ in spec.shapes)
+    else:
+        raise ValueError(f"unknown megakernel variant {spec.variant!r}")
+
+    donate = tuple(i for i, d in enumerate(spec.donate) if d)
+    return jax.jit(
+        _compat.shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False),
+        donate_argnums=donate)
+
+
+def _pack_key(shapes, dtype, donate, mesh_key):
+    return GroupSpec(mesh_key=mesh_key, variant="pack", op="psum",
+                     average=False, denom=1, dtype=dtype, shapes=shapes,
+                     donate=donate)
+
+
+def _cache_insert(spec: GroupSpec, fn: Callable,
+                  digest: Optional[str] = None) -> None:
+    """Bounded insert shared by :func:`packer` and :func:`executable`:
+    on overflow the whole table clears (wholesale, like the fusion-plan
+    memo) rather than aging entries out."""
+    with _lock:
+        if len(_compiled) >= CACHE_CAPACITY:
+            _compiled.clear()
+            _digests.clear()
+            _by_digest.clear()
+            stats.flushes += 1
+        _compiled[spec] = fn
+        if digest is not None:
+            _digests[spec] = digest
+            _by_digest[digest] = spec
+        stats.builds += 1
+
+
+def packer(shapes: Tuple[Tuple[int, ...], ...], dtype: str,
+           donate: Tuple[bool, ...], mesh_key) -> Callable:
+    """Jitted local pack (multi-process leg): flatten + concatenate the
+    group's local contributions into one fusion buffer in a single
+    dispatch, donating the executor-owned inputs."""
+    spec = _pack_key(shapes, dtype, donate, mesh_key)
+    with _lock:
+        fn = _compiled.get(spec)
+        if fn is not None:
+            stats.cache_hits += 1
+            return fn
+    fn = jax.jit(
+        lambda *ts: jnp.concatenate([t.reshape(-1) for t in ts]),
+        donate_argnums=tuple(i for i, d in enumerate(donate) if d))
+    _cache_insert(spec, fn)
+    return fn
+
+
+def executable(spec: GroupSpec, mesh,
+               digest_fn: Optional[Callable[[], str]] = None) -> Callable:
+    """The compiled megakernel for ``spec`` — cached, bounded, recorded
+    under its fusion-plan digest on the cold build (``digest_fn`` is
+    only invoked then, keeping the hot path free of hashing)."""
+    with _lock:
+        fn = _compiled.get(spec)
+        if fn is not None:
+            stats.cache_hits += 1
+            return fn
+    fn = _build(spec, mesh)
+    _cache_insert(spec, fn,
+                  digest_fn() if digest_fn is not None else None)
+    return fn
+
+
+def launch(spec: GroupSpec, mesh, values: Sequence,
+           digest_fn: Optional[Callable[[], str]] = None):
+    """One megakernel dispatch for a fusion group.  Under dispatch
+    counting (tests/bench) the launch is wrapped in a thread-local
+    window and the observed executable count is accumulated on
+    ``stats`` — the "exactly one dispatch per group" regression
+    contract — and the donated inputs are recorded as weakrefs for the
+    use-after-donate probe."""
+    fn = executable(spec, mesh, digest_fn)
+    counting = _xla_dispatch.counting_enabled()
+    if counting:
+        probes = [weakref.ref(v)
+                  for v, d in zip(values, spec.donate) if d]
+        with _xla_dispatch.record() as scope:
+            outs = fn(*values)
+        with _lock:
+            stats.launches += 1
+            stats.launch_dispatches += scope.count
+            stats.donated_inputs += sum(spec.donate)
+            if spec.hier is not None:
+                stats.hier_launches += 1
+            last_donated[:] = probes
+    else:
+        outs = fn(*values)
+        with _lock:
+            stats.launches += 1
+            stats.donated_inputs += sum(spec.donate)
+            if spec.hier is not None:
+                stats.hier_launches += 1
+    return outs

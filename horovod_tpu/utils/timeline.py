@@ -158,6 +158,14 @@ class Timeline:
     def activity_start(self, tensor: str, activity: str) -> None:
         self._event(_PH_BEGIN, tensor, activity)
 
+    def instant(self, tensor: str, name: str,
+                args: Optional[dict] = None) -> None:
+        """Zero-duration marker on the tensor's row — used for events
+        that happen inside one compiled launch and so have no host-side
+        duration of their own (e.g. DCN_ALLREDUCE: the hierarchical
+        megakernel's cross-slice leg, docs/timeline.md)."""
+        self._event(_PH_INSTANT, tensor, name, args)
+
     def activity_end(self, tensor: str) -> None:
         self._event(_PH_END, tensor)
 

@@ -31,6 +31,14 @@ os.environ.setdefault("KERAS_BACKEND", "jax")
 # exhibits the ordering (analysis/lockorder.py).  Must be set before
 # horovod_tpu creates its locks.
 os.environ.setdefault("HVD_TPU_LOCK_CHECK", "1")
+# XLA executable-launch counting on for the whole suite
+# (utils/xla_dispatch.py): every megakernel launch is wrapped in a
+# thread-local dispatch window, so the "exactly one executable per
+# fusion group" contract is continuously accumulated on
+# ops.megakernel.stats and asserted by tests/test_megakernel.py —
+# eager-op creep inside the fused executor fails the suite, not just
+# the dedicated test's scenario.
+os.environ.setdefault("HVD_TPU_COUNT_DISPATCHES", "1")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
