@@ -290,6 +290,33 @@ def _control_bench(tensors: int = 64, ranks: int = 4,
 
     off_rate, off_cycles = measure(cache_on=False)
     on_rate, on_cycles = measure(cache_on=True)
+
+    # Telemetry overhead A/B (the hvd-telemetry acceptance gate,
+    # docs/metrics.md): the SAME steady-state measurement with the
+    # whole subsystem (registry + flight recorder) disabled.  Recorded
+    # in the JSON — ≤ 5 % regression is the contract; the boolean is
+    # informational (a loaded box can fake either direction).
+    from horovod_tpu import telemetry as _telemetry
+
+    was_enabled = _telemetry.enabled()
+    _telemetry.set_enabled(False)
+    try:
+        notel_on_rate, _ = measure(cache_on=True)
+        notel_off_rate, _ = measure(cache_on=False)
+    finally:
+        _telemetry.set_enabled(was_enabled)
+
+    def overhead_pct(with_tel, without_tel):
+        if not without_tel:
+            return None
+        return round((1.0 - with_tel / without_tel) * 100.0, 2)
+
+    tel_pct = overhead_pct(on_rate, notel_on_rate)
+    tel_counters = {
+        name: m.get("value")
+        for name, m in _telemetry.metrics().items()
+        if m.get("type") in ("counter", "gauge")
+    }
     return {
         "metric": "control_plane_negotiations_per_sec",
         "value": round(on_rate, 1),
@@ -301,6 +328,16 @@ def _control_bench(tensors: int = 64, ranks: int = 4,
         "tensors": tensors,
         "ranks": ranks,
         "cycles": {"cache_on": on_cycles, "cache_off": off_cycles},
+        "telemetry": {
+            "cache_on_metrics_on": round(on_rate, 1),
+            "cache_on_metrics_off": round(notel_on_rate, 1),
+            "cache_off_metrics_on": round(off_rate, 1),
+            "cache_off_metrics_off": round(notel_off_rate, 1),
+            "overhead_pct": tel_pct,
+            "overhead_off_pct": overhead_pct(off_rate, notel_off_rate),
+            "overhead_ok": tel_pct is not None and tel_pct <= 5.0,
+            "counters": tel_counters,
+        },
     }
 
 
@@ -393,7 +430,29 @@ def _dataplane_bench(tensors: int = 32, elems: int = 256,
         finally:
             del os.environ["HVD_TPU_HIERARCHICAL"]
             del os.environ["HVD_TPU_VIRTUAL_SLICES"]
+
+        # Telemetry overhead A/B on the megakernel leg (same contract
+        # as --mode control: the hvd-telemetry acceptance gate rides
+        # the bench JSON).  The executor instrumentation is per
+        # fused-response, so the expected delta is noise-level.
+        from horovod_tpu import telemetry as _telemetry
+
+        was_enabled = _telemetry.enabled()
+        _telemetry.set_enabled(False)
+        try:
+            _, _, mega_lat_notel, _ = measure("notel", True)
+        finally:
+            _telemetry.set_enabled(was_enabled)
             mk.set_enabled(None)
+        tel_pct = (round((mega_lat / mega_lat_notel - 1.0) * 100.0, 2)
+                   if mega_lat_notel else None)
+        snap = _telemetry.metrics()
+        tel_counters = {
+            name: m.get("value") for name, m in snap.items()
+            if name.startswith(("megakernel.", "collective.", "cache."))
+            and m.get("type") in ("counter", "gauge")
+        }
+
         reduction = (eager_disp / mega_disp) if mega_disp else None
         return {
             "metric": "dataplane_fused_cycle_latency_us",
@@ -414,6 +473,14 @@ def _dataplane_bench(tensors: int = 32, elems: int = 256,
             "tensors": tensors,
             "elems": elems,
             "replicas": n,
+            "telemetry": {
+                "megakernel_us_metrics_on": round(mega_lat * 1e6, 1),
+                "megakernel_us_metrics_off": round(
+                    mega_lat_notel * 1e6, 1),
+                "overhead_pct": tel_pct,
+                "overhead_ok": tel_pct is not None and tel_pct <= 5.0,
+                "counters": tel_counters,
+            },
         }
     finally:
         hvd.shutdown()
@@ -636,16 +703,28 @@ def _run_child(extra_args, timeout):
     timed_out = False
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, env=env)
     try:
-        stdout, _ = proc.communicate(timeout=timeout)
-        rc = proc.returncode
-    except subprocess.TimeoutExpired:
-        timed_out = True
-        proc.kill()  # SIGKILL — see docstring
         try:
-            stdout, _ = proc.communicate(timeout=10)
+            stdout, _ = proc.communicate(timeout=timeout)
+            rc = proc.returncode
         except subprocess.TimeoutExpired:
-            stdout = b""
-        rc = 0
+            timed_out = True
+            proc.kill()  # SIGKILL — see docstring
+            try:
+                stdout, _ = proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                stdout = b""
+            rc = 0
+    finally:
+        # ANY other exit path (KeyboardInterrupt, a raise from
+        # communicate, the salvage timing out) must also SIGKILL the
+        # child: a wedged tunnel child outlives SIGTERM and its parent
+        # by 20+ minutes, eating the next attempt's budget.
+        if proc.poll() is None:
+            proc.kill()
+            try:
+                proc.communicate(timeout=10)
+            except Exception:  # noqa: BLE001 — reaping is best-effort
+                pass
     payload = None
     for ln in reversed((stdout or b"").decode(errors="replace")
                        .splitlines()):

@@ -98,5 +98,7 @@ from .parallel.data import (  # noqa: F401
 from . import elastic  # noqa: F401  (hvd.elastic.State / @hvd.elastic.run)
 from . import analysis  # noqa: F401  (hvd.analysis.verify_program & co)
 from .analysis.program import verify_program  # noqa: F401
+from . import telemetry  # noqa: F401  (hvd.telemetry.flight & registry)
+from .telemetry import cluster_metrics, metrics  # noqa: F401
 
 __version__ = "0.1.0"

@@ -97,6 +97,16 @@ def _record_acquire(name: str) -> None:
                              for f in reversed(frame)
                              if "lockorder" not in f.filename), "?")
                 _graph_edges_sites[edge] = site
+                # New-edge breadcrumb for the hvd-telemetry flight ring
+                # (telemetry/flight.py is stdlib-only, so this lazy
+                # import cannot cycle back through make_lock).  New
+                # edges appear a handful of times per process lifetime.
+                try:
+                    from ..telemetry import flight as _flight
+
+                    _flight.record("lock_edge", held, name, site)
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
     stack.append(name)
 
 

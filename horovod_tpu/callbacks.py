@@ -41,18 +41,31 @@ def _average_metric(allreduce_fn, metric: str, value):
     """Allreduce-average one logged metric; returns None for values that
     must pass through untouched (strings, objects).  The reference
     averages ANY logged value (keras/callbacks.py:37-87), so arrays
-    (per-class accuracies, confusion rows) average too — scalars come
-    back as Python floats (the historical contract), arrays as float32
-    ndarrays."""
+    (per-class accuracies, confusion rows) average too.
+
+    Dtype contract: the average is computed in
+    ``promote_types(dtype, float32)``, so float64 (and wider) inputs
+    keep their dtype instead of being silently truncated to float32
+    (the pre-round-6 behavior); ints average as floats (an averaged
+    count is fractional).  Scalars come back as Python floats (the
+    historical contract), arrays as ndarrays of the accumulation
+    dtype.  NOTE: without ``jax_enable_x64`` the on-device reduction
+    itself still runs in float32 — the contract here is the *dtype* of
+    the result; full float64 wire precision additionally needs x64
+    enabled."""
     try:
         arr = np.asarray(value)
     except Exception:
         return None
     if arr.dtype.kind not in "biuf":
         return None
-    red = allreduce_fn(arr.astype(np.float32, copy=False), average=True,
+    acc = np.promote_types(arr.dtype, np.float32)
+    red = allreduce_fn(arr.astype(acc, copy=False), average=True,
                        name=f"metric.{metric}")
-    return float(np.asarray(red)) if arr.ndim == 0 else np.asarray(red)
+    out = np.asarray(red)
+    if arr.ndim == 0:
+        return float(out)
+    return out.astype(acc, copy=False)
 
 
 class MetricAverageCallback(Callback):
@@ -72,6 +85,70 @@ class MetricAverageCallback(Callback):
             red = _average_metric(C.allreduce, metric, logs[metric])
             if red is not None:
                 logs[metric] = red
+
+
+#: Default metric selection for :class:`MetricsLogger` — the handful
+#: that answers "is the control plane healthy" at a glance; pass
+#: ``metrics="all"`` for every scalar metric in the registry.
+_DEFAULT_LOGGED_METRICS = (
+    "collective.submitted",
+    "collective.completed",
+    "collective.errors",
+    "cache.hits",
+    "cache.misses",
+    "events.stall_warnings",
+    "events.dead_peers",
+    "handles.live",
+)
+
+
+class MetricsLogger(Callback):
+    """Attach hvd-telemetry values to the epoch logs (docs/metrics.md).
+
+    At each epoch end the selected registry metrics are written into
+    ``logs`` under ``<prefix><name>`` (scalars only — histograms log
+    their ``count``), so downstream logging callbacks, CSV writers and
+    early-stopping hooks see control-plane health next to the model
+    metrics; ``verbose=1`` also prints one summary line.
+
+    ``metrics`` is an iterable of registry names, ``"all"`` for every
+    metric, or None for a curated control-plane-health default.
+    """
+
+    def __init__(self, metrics=None, prefix: str = "hvd/",
+                 verbose: int = 0):
+        self.metrics = metrics
+        self.prefix = prefix
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch: int, logs=None) -> None:
+        from . import telemetry
+
+        snap = telemetry.metrics()
+        if self.metrics == "all":
+            names = sorted(snap)
+        elif self.metrics is None:
+            names = [n for n in _DEFAULT_LOGGED_METRICS if n in snap]
+        elif isinstance(self.metrics, str):
+            # A single metric name (not the "all" sentinel): treat it
+            # as a one-element selection instead of iterating its
+            # characters and silently logging nothing.
+            names = [self.metrics] if self.metrics in snap else []
+        else:
+            names = [n for n in self.metrics if n in snap]
+        rendered = {}
+        for name in names:
+            m = snap[name]
+            v = m.get("count") if m.get("type") == "histogram" \
+                else m.get("value")
+            if v is None:
+                continue
+            rendered[name] = v
+            if logs is not None:
+                logs[self.prefix + name] = v
+        if self.verbose:
+            line = ", ".join(f"{k}={v}" for k, v in rendered.items())
+            print(f"[hvd-telemetry] epoch {epoch}: {line}")
 
 
 class LearningRateScheduleCallback(Callback):

@@ -33,6 +33,7 @@ assumes.  Inside traced per-replica code the true replica id is
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
@@ -108,6 +109,9 @@ class _GlobalState:
     next_process_set_id: int = 1
     # Timeline (utils.timeline.Timeline) when HOROVOD_TIMELINE is set.
     timeline: Any = None
+    # hvd-telemetry HTTP exporter (telemetry/exporter.py) when
+    # HVD_TPU_METRICS_PORT is set (rank 0 by default).
+    metrics_exporter: Any = None
     # Steady-state negotiation response cache (ops.cache.ResponseCache);
     # one replica per rank, shared by the coordinator facades and the
     # transport.  None when HVD_TPU_RESPONSE_CACHE=0 or the program
@@ -291,6 +295,31 @@ def init(devices=None) -> None:
         else:
             _state.autotuner = None
 
+        # hvd-telemetry: register the pull-side collector over the
+        # runtime's stats structs (idempotent across re-inits) and, when
+        # HVD_TPU_METRICS_PORT is set, serve /metrics + /healthz — rank
+        # 0 only unless HVD_TPU_METRICS_ALL_RANKS=1 (docs/metrics.md).
+        from .. import telemetry as _telemetry
+
+        _telemetry.install_runtime_collector()
+        port = os.environ.get("HVD_TPU_METRICS_PORT")
+        if port and _state.metrics_exporter is None and (
+                _state.process_index == 0
+                or os.environ.get("HVD_TPU_METRICS_ALL_RANKS") == "1"):
+            from ..telemetry import exporter as _exporter
+
+            try:
+                # ValueError too: a typo'd port is an observability env
+                # mistake and must not abort the training job.
+                _state.metrics_exporter = _exporter.start_exporter(
+                    _telemetry.registry(), int(port.strip()),
+                    host=os.environ.get("HVD_TPU_METRICS_HOST",
+                                        "0.0.0.0"))
+            except (OSError, ValueError) as e:
+                print(f"WARNING: hvd-telemetry exporter could not serve "
+                      f"on HVD_TPU_METRICS_PORT={port!r}: {e}",
+                      file=sys.stderr)
+
         # Spawn the background tick thread serving async eager collectives
         # (≙ InitializeHorovodOnce spawning BackgroundThreadLoop,
         # operations.cc:1481-1483).
@@ -366,6 +395,9 @@ def shutdown() -> None:
         if _state.timeline is not None:
             _state.timeline.close()
             _state.timeline = None
+        if _state.metrics_exporter is not None:
+            _state.metrics_exporter.close()
+            _state.metrics_exporter = None
         if _state.transport is not None:
             _state.transport.close()
             _state.transport = None

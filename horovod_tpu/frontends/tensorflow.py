@@ -214,9 +214,11 @@ def allreduce(tensor, average=None, name: Optional[str] = None,
     exchange exactly like the reference (tensorflow/__init__.py:67-78);
     they already ship a minimal payload, so ``compression`` (the dense
     wire cast, ``hvd.Compression.fp16``/``bf16``) applies to dense
-    tensors only.  ``op`` (hvd.Average/Sum/Adasum/Min/Max/Product,
-    superseding ``average``) and ``process_set`` carry the post-v0.13
-    contracts; sparse inputs accept sum/average only.
+    tensors only.  ``op`` (hvd.Average/Sum/Adasum/Min/Max/Product) and
+    ``average`` are mutually exclusive — passing both raises
+    ValueError, and with neither the call averages by default;
+    ``process_set`` carries the post-v0.13 contract; sparse inputs
+    accept sum/average only.
 
     Inside ``tf.function`` the collective becomes a ``tf.py_function``
     bridge node executing the same eager queue path mid-graph (see the
@@ -403,8 +405,10 @@ def grouped_allreduce(tensors, average=None,
     ``hvd.grouped_allreduce``, sync variant — the async handle surface
     stays on the torch frontend, matching the reference's split).
 
-    ``op`` takes hvd.Average/Sum/Adasum/Min/Max/Product and supersedes
-    ``average`` (averages by default).  Eager: every op is submitted
+    ``op`` takes hvd.Average/Sum/Adasum/Min/Max/Product; ``op`` and
+    ``average`` are mutually exclusive (passing both raises
+    ValueError), and with neither the group averages by default.
+    Eager: every op is submitted
     async before any synchronize, so Tensor Fusion packs the group into
     ~one wire collective.  Inside ``tf.function`` the whole group
     becomes ONE ``tf.py_function`` node — the batch drain that keeps

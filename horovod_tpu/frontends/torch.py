@@ -237,10 +237,11 @@ def allreduce(tensor, average=None, name: Optional[str] = None,
               process_set=None) -> torch.Tensor:
     """``compression`` (``hvd.Compression.fp16``/``bf16``) casts the
     tensor down for the wire and restores its dtype after; ``op`` takes
-    hvd.Average/Sum/Adasum/Min/Max/Product and supersedes ``average``;
-    ``process_set`` (from ``add_process_set``) restricts the collective
-    to a rank subset — the kwarg contracts Horovod later standardized
-    for this API."""
+    hvd.Average/Sum/Adasum/Min/Max/Product, is mutually exclusive with
+    ``average`` (passing both raises ValueError; with neither the call
+    averages by default); ``process_set`` (from ``add_process_set``)
+    restricts the collective to a rank subset — the kwarg contracts
+    Horovod later standardized for this API."""
     return synchronize(allreduce_async(tensor, average, name, compression,
                                        op, process_set))
 

@@ -72,6 +72,7 @@ from . import wire
 from .wire import Request, Response, ResponseType
 from ..analysis import lockorder as _lockorder
 from ..analysis import program as _program
+from ..telemetry import flight as _flight
 
 # Retired epochs kept for stale-bit downgrade resolution.  Bits flow at
 # the 5 ms drain cadence while flushes are rare events, so a handful of
@@ -336,6 +337,10 @@ class ResponseCache:
         if broadcast:
             self._marker = (self._epoch, self._disarmed)
         self.stats.flushes += 1
+        # Flight ring: epoch transitions are exactly the divergence
+        # points a forensic replay needs (record() takes no lock, so
+        # the cache lock stays a leaf).
+        _flight.record("cache_flush", reason, self._epoch, n)
         if n or disarm:
             self._log(f"cache flush ({reason}): {n} entries dropped, "
                       f"epoch {self._epoch}"
@@ -479,6 +484,8 @@ class ResponseCache:
             entry = retired.get(idx)
             if entry is not None and grank in entry.requests:
                 self.stats.downgrades += 1
+                _flight.record("cache_downgrade", entry.name, grank,
+                               epoch)
                 return entry.requests[grank]
         self._log(f"dropping unresolvable cache bit (entry {idx}, rank "
                   f"{grank}, epoch {epoch}; current epoch {self.epoch})")

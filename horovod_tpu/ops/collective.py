@@ -37,10 +37,10 @@ reference: docs/tensor-fusion.md, operations.cc:1328-1374) when drained.
 
 from __future__ import annotations
 
-import functools
 import math
 import sys
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
 from ..analysis import program as _program
 from ..core import compat as _compat
@@ -81,6 +82,28 @@ class HorovodError(RuntimeError):
     """Cross-replica validation failure (≙ the reference's
     FailedPreconditionError surfaced from ERROR responses,
     operations.cc:1060-1067)."""
+
+
+# hvd-telemetry instrumentation (docs/metrics.md).  Event-granularity
+# budget: _enqueue and the response executor each spend exactly one
+# perf_counter pair per event; the per-submit steady-state hot path
+# (cache hits) is instrumented pull-side from CacheStats instead.
+_M_SUBMITTED = _telemetry.counter(
+    "collective.submitted", "eager collectives entering negotiation")
+_M_COMPLETED = _telemetry.counter(
+    "collective.completed", "eager collectives executed")
+_M_ERRORS = _telemetry.counter(
+    "collective.errors", "validation/shutdown errors surfaced")
+_M_NEGOTIATE_S = _telemetry.histogram(
+    "collective.negotiate_seconds", "seconds",
+    "submit -> broadcast response (negotiate + queue phases)")
+_M_EXECUTE_S = _telemetry.histogram(
+    "collective.execute_seconds", "seconds",
+    "response -> XLA dispatch complete (execute phase)")
+_M_PAYLOAD_B = _telemetry.histogram(
+    "collective.payload_bytes", "bytes", "per-tensor payload size")
+_M_GROUP_WIDTH = _telemetry.histogram(
+    "fusion.group_width", "count", "tensors per fused response")
 
 
 # Error-message parity with the reference's SHUT_DOWN_ERROR
@@ -137,6 +160,8 @@ def _handle_lost_ranks(st, tp) -> None:
     pending = bool(_queue.pending_meta()) or bool(
         st.coordinator.check_stalled(threshold=0.0))
     detail = " while collectives were pending" if pending else ""
+    _telemetry.dead_peer_event(
+        f"rank(s) {ranks} {wire.DEAD_PEER_MARKER}{detail}")
     _initiate_shutdown(
         f"Horovod has been shut down: rank(s) {ranks} "
         f"{wire.DEAD_PEER_MARKER}{detail}.")
@@ -593,15 +618,60 @@ def _build_kernels(mesh):
     }
 
 
-@functools.lru_cache(maxsize=None)
+# Compiled-kernel tables.  Previously unbounded lru_caches keyed on
+# Device OBJECTS: a restarted backend mints fresh Devices that never
+# compare equal to the dead ones, so the old entries became immortal,
+# pinning dead meshes and their jitted kernels forever.  This bounded
+# cache keeps the useful property (same-backend re-inits — every test —
+# share one compilation because live Devices compare equal) while, on
+# every miss, evicting entries whose Device objects no longer appear in
+# ``jax.devices()``, plus insertion-order overflow eviction as a
+# backstop.
+_KERNEL_CACHE_CAPACITY = 16
+_kernel_cache_lock = _lockorder.make_lock("collective._kernel_cache")
+# table name -> {device-tuple key -> built kernels}
+_kernel_caches: Dict[str, dict] = {
+    "replica": {}, "subset": {}, "mp": {}}  # guarded_by: _kernel_cache_lock
+
+
+def _cached_kernels(table: str, key: tuple, build):
+    with _kernel_cache_lock:
+        hit = _kernel_caches[table].get(key)
+    if hit is not None:
+        return hit
+    # Miss: evict stale-device and overflow entries first; the build
+    # itself runs OUTSIDE the lock (jit construction must never happen
+    # under a runtime lock), and a concurrent builder's entry wins via
+    # setdefault.
+    try:
+        live = set(jax.devices())
+    except Exception:  # noqa: BLE001 — backend down; skip eviction
+        live = None
+    with _kernel_cache_lock:
+        if live is not None:
+            # Stale-device entries are dead in EVERY table (the backend
+            # restarted) — sweep them all.
+            for cache in _kernel_caches.values():
+                for k in [k for k in cache if not set(k) <= live]:
+                    del cache[k]
+        # The overflow backstop applies only to the table receiving
+        # this insert: another table's live at-capacity entries must
+        # not lose compilations to an unrelated miss.
+        target = _kernel_caches[table]
+        while len(target) >= _KERNEL_CACHE_CAPACITY:
+            del target[next(iter(target))]  # oldest insertion first
+    built = build()
+    with _kernel_cache_lock:
+        return _kernel_caches[table].setdefault(key, built)
+
+
 def _kernels(mesh_key):
-    """Kernels over the replica mesh; ``mesh_key`` (the tuple of Device
-    OBJECTS, not ids) rebuilds them when the replica set changes (tests
-    re-init with device subsets) AND when the backend itself restarts —
-    a fresh backend mints fresh Device objects that never compare equal
-    to the dead ones, so a stale mesh can't be handed back, while
-    same-backend re-inits (every test) keep sharing one compilation."""
-    return _build_kernels(_state.global_state().mesh)
+    """Kernels over the replica mesh; ``mesh_key`` is the tuple of
+    Device OBJECTS (not ids) so the replica set changing (tests re-init
+    with device subsets) or the backend restarting rebuilds them."""
+    return _cached_kernels(
+        "replica", mesh_key,
+        lambda: _build_kernels(_state.global_state().mesh))
 
 
 def _mesh_kernels():
@@ -609,13 +679,16 @@ def _mesh_kernels():
     return _kernels(tuple(st.devices))
 
 
-@functools.lru_cache(maxsize=None)
 def _subset_kernels(devs: tuple):
     """Mesh + kernels over an arbitrary device subset, cached by the
     device tuple so process sets over identical subsets (or the same set
     re-registered across re-inits) share one compilation."""
-    mesh = jax.sharding.Mesh(np.asarray(devs), (REPLICA_AXIS,))
-    return mesh, _build_kernels(mesh)
+
+    def build():
+        mesh = jax.sharding.Mesh(np.asarray(devs), (REPLICA_AXIS,))
+        return mesh, _build_kernels(mesh)
+
+    return _cached_kernels("subset", devs, build)
 
 
 # ---------------------------------------------------------------------------
@@ -627,17 +700,20 @@ def _subset_kernels(devs: tuple):
 # one-GPU-per-rank binding; any extra local devices serve the static pjit
 # path instead.
 
-@functools.lru_cache(maxsize=None)
 def _mp_mesh_and_kernels(mesh_key):
     # mesh_key is the tuple of local Device objects (see _kernels on why
-    # object identity, not ids).
-    by_proc: Dict[int, Any] = {}
-    for d in jax.devices():
-        if d.process_index not in by_proc or d.id < by_proc[d.process_index].id:
-            by_proc[d.process_index] = d
-    devs = [by_proc[p] for p in sorted(by_proc)]
-    mesh = jax.sharding.Mesh(np.asarray(devs), (REPLICA_AXIS,))
-    return mesh, _build_kernels(mesh)
+    # object identity, not ids; bounded + stale-evicting like _kernels).
+    def build():
+        by_proc: Dict[int, Any] = {}
+        for d in jax.devices():
+            if d.process_index not in by_proc \
+                    or d.id < by_proc[d.process_index].id:
+                by_proc[d.process_index] = d
+        devs = [by_proc[p] for p in sorted(by_proc)]
+        mesh = jax.sharding.Mesh(np.asarray(devs), (REPLICA_AXIS,))
+        return mesh, _build_kernels(mesh)
+
+    return _cached_kernels("mp", mesh_key, build)
 
 
 def _mp_kernels():
@@ -885,6 +961,9 @@ class _QueuedOp:
     # True when negotiation was served from the response cache — rides
     # the timeline EXECUTE span so cache wins are visible per tensor.
     cache_hit: bool = False
+    # perf_counter at enqueue: the telemetry negotiate-latency stamp
+    # (the one clock read this op spends before execution).
+    t_submit: float = 0.0
 
 
 class _OpQueue:
@@ -954,6 +1033,10 @@ def _background_loop(stop_event: threading.Event) -> None:
             # Validation errors never propagate here (they are stored on
             # handles); anything that does is a runtime bug — report it
             # rather than silently dropping queued ops, but keep ticking.
+            # The flight ring dumps too: the drain thread IS the control
+            # plane, and the events before the exception are the
+            # diagnosis.
+            _telemetry.exception_event("drain", traceback.format_exc())
             traceback.print_exc(file=sys.stderr)
 
 
@@ -1013,7 +1096,48 @@ def _tl_start(tl, o: _QueuedOp, op_name: str) -> None:
                    "cache": "hit" if o.cache_hit else "miss"})
 
 
+_DATA_RESPONSES = (ResponseType.ALLREDUCE, ResponseType.ALLGATHER,
+                   ResponseType.BROADCAST, ResponseType.REDUCESCATTER,
+                   ResponseType.ALLTOALL)
+
+
 def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
+    """Telemetry shell around :func:`_execute_response_inner`: one
+    perf_counter pair per response feeds the negotiate- and
+    execute-latency histograms, payload bytes and fusion-group width;
+    ERROR and dead-peer SHUTDOWN responses additionally dump the flight
+    ring — the forensic record of the 2000 control-plane events that
+    led here."""
+    if not _telemetry.enabled():
+        return _execute_response_inner(resp, ops)
+    t0 = time.perf_counter()
+    is_data = resp.response_type in _DATA_RESPONSES
+    for o in ops:
+        if o.t_submit:
+            _M_NEGOTIATE_S.observe(t0 - o.t_submit)
+        _M_PAYLOAD_B.observe(o.nbytes)
+    if is_data:
+        _M_GROUP_WIDTH.observe(len(resp.tensor_names))
+    elif resp.response_type == ResponseType.ERROR:
+        _M_ERRORS.inc(max(len(ops), 1))
+        _telemetry.error_event(resp.error_message or "")
+    elif resp.response_type == ResponseType.SHUTDOWN and \
+            wire.DEAD_PEER_MARKER in (resp.error_message or ""):
+        # Worker-side dead-peer poison (the controller side dumps in
+        # _handle_lost_ranks before broadcasting this diagnosis).
+        _telemetry.dead_peer_event(resp.error_message or "")
+    out = _execute_response_inner(resp, ops)
+    # Counted AFTER a successful data launch only: an ERROR/SHUTDOWN
+    # response (or an exception from the executor) must not inflate the
+    # success counter — "failed = submitted - completed" has to read
+    # true during a failure storm.
+    if ops and is_data:
+        _M_COMPLETED.inc(len(ops))
+        _M_EXECUTE_S.observe(time.perf_counter() - t0)
+    return out
+
+
+def _execute_response_inner(resp: Response, ops: List[_QueuedOp]) -> None:
     """Launch the XLA collective(s) for one coordinator response.
 
     A fused ALLREDUCE response concatenates its tensors into one flat
@@ -1765,15 +1889,15 @@ def _drain() -> None:
 def _resolve_op(average, op) -> ReduceOp:
     """Resolve the (average, op) pair into one ReduceOp.
 
-    Mirrors the post-v0.13 Horovod contract: ``op`` supersedes
-    ``average`` and passing both is an error; with neither, the default
-    is Average (the reference's allreduce default,
+    Mirrors the post-v0.13 Horovod contract: ``op`` and ``average`` are
+    mutually exclusive — passing both raises ValueError; with neither,
+    the default is Average (the reference's allreduce default,
     tensorflow/__init__.py:49, torch/mpi_ops.py:58)."""
     if op is not None:
         if average is not None:
             raise ValueError(
                 "specify either average= or op=, not both "
-                "(op supersedes average).")
+                "(they are mutually exclusive).")
         return ReduceOp(op)
     if average is None or average:
         return ReduceOp.AVERAGE
@@ -1842,9 +1966,15 @@ def _enqueue(x, op: RequestType, name: Optional[str],
         process_set_id=0 if process_set is None
         else process_set.process_set_id)
     handle = st.handle_manager.allocate(None, name=name)
+    # Clock stamp gated like every other instrument: disabled telemetry
+    # must cost a flag check, and the bench's overhead A/B must compare
+    # against a leg that truly pays nothing.
     qop = _QueuedOp(name=name, op=op, contrib=c, red_op=red_op,
                     root_rank=root_rank, handle=handle, nbytes=nbytes,
-                    ps=process_set)
+                    ps=process_set,
+                    t_submit=(time.perf_counter()
+                              if _telemetry.enabled() else 0.0))
+    _M_SUBMITTED.inc()
     _queue.put(qop)
     # The execute paths read split info from the NEGOTIATED response
     # matrix, never from the local op — splits ride the request only.
@@ -1863,9 +1993,9 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
     Averages by default for parity with the reference API
     (torch/mpi_ops.py:58, tensorflow/__init__.py:49); ``op`` takes any
     of hvd.Average/Sum/Adasum/Min/Max/Product (the post-v0.13 API) and
-    supersedes ``average``; ``process_set`` (from
-    :func:`add_process_set`) restricts the collective to a rank
-    subset."""
+    is mutually exclusive with ``average`` (passing both raises
+    ValueError); ``process_set`` (from :func:`add_process_set`)
+    restricts the collective to a rank subset."""
     return _enqueue(tensor, RequestType.ALLREDUCE, name,
                     red_op=_resolve_op(average, op), prefix="allreduce",
                     process_set=process_set)
@@ -2255,7 +2385,8 @@ def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
     (defaults match the reference: tensorflow/__init__.py:49,
     torch/mpi_ops.py:58), or any reduction via ``op`` —
     hvd.Average/Sum/Adasum/Min/Max/Product (the post-v0.13 API; ``op``
-    supersedes ``average``); ``process_set`` restricts to a rank subset.
+    and ``average`` are mutually exclusive — passing both raises);
+    ``process_set`` restricts to a rank subset.
 
     :class:`~horovod_tpu.ops.sparse.IndexedSlices` inputs dispatch to the
     sparse gather-of-(values, indices) path transparently, exactly like

@@ -41,15 +41,22 @@ from typing import Dict, List, Optional
 from . import cache as _cache
 from . import wire
 from .wire import (DataType, Request, RequestType, Response, ResponseType)
+from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
 from ..analysis import program as _program
 from ..native import lib as _native
+from ..telemetry import flight as _flight
 
 # Seconds a tensor may sit in negotiation before a stall warning
 # (≙ STALL_WARNING_TIME, operations.cc:208).  Env-tunable so tests and
 # impatient deployments can tighten the watchdog.
 STALL_WARNING_SECONDS = float(
     os.environ.get("HOROVOD_STALL_WARNING_SECONDS", "60"))
+
+_M_WITHDRAWALS = _telemetry.counter(
+    "events.withdrawals", "collectives abandoned by a timed-out rank")
+# Bound once: the submit miss path calls this per request.
+_flight_record = _flight.recorder.record
 
 
 @dataclass
@@ -665,6 +672,15 @@ class Coordinator:
                     # (surfacing the usual mismatch diagnostics).
                     self._resubmit(info)
                 self._retain(req)
+        # Flight ring: real (non-cache-hit) negotiation traffic.  The
+        # steady-state hit path above returns before this point, so the
+        # ring records exactly the divergences a forensic replay needs
+        # — misses, first-time programs, downgrades — not the per-step
+        # replay noise (which the replay/frame events already cover).
+        # Bound method + raw enum: this runs once per miss-submit, and
+        # the enum stringifies at dump time, not here.
+        _flight_record("submit", req.tensor_name, req.request_rank,
+                       req.request_type)
         self._impl_dirty = True
         done = self._impl.submit(req)
         if done and self.timeline is not None:
@@ -690,6 +706,8 @@ class Coordinator:
                 pass  # duplicate: the rank re-submitted meanwhile
 
     def withdraw(self, name: str, rank: int) -> None:
+        _M_WITHDRAWALS.inc()
+        _flight.record("withdraw", name, rank)
         if self.cache is not None:
             # A withdrawal is a program-divergence signal (a rank timed
             # out waiting): invalidate, downgrading any mid-flight
@@ -719,8 +737,16 @@ class Coordinator:
         now = time.monotonic()
         if now - self._last_stall_check > STALL_WARNING_SECONDS:
             self._last_stall_check = now
-            for w in self._impl.check_stalled(now):
+            # Threshold passed explicitly (the module global, read at
+            # call time) so tests can tighten the watchdog, and the
+            # warnings feed the telemetry stall counter + a flight-
+            # recorder dump whose tail names the stalled tensor and the
+            # non-ready ranks.
+            warnings = self._impl.check_stalled(now,
+                                                STALL_WARNING_SECONDS)
+            for w in warnings:
                 print(f"WARNING: {w}", file=sys.stderr)
+            _telemetry.stall_event(warnings)
         if self.cache is not None and not self._impl_dirty:
             # Steady state: every request since the last poll was a
             # cache hit, so the impl's tables are exactly as the last

@@ -26,7 +26,15 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 
+from .. import telemetry as _telemetry
 from ..native import lib as _native
+
+# Handle churn counters (pool DEPTH is the handles.live gauge, read
+# pull-side from live_count() by the runtime collector).
+_M_ALLOCATED = _telemetry.counter(
+    "handles.allocated", "async-collective handles created")
+_M_RELEASED = _telemetry.counter(
+    "handles.released", "handles synchronized and released")
 
 
 class Handle:
@@ -66,6 +74,7 @@ class HandleManager:
                  name: str = "") -> int:
         hid = _native.handle_manager_allocate(self._native)
         h = Handle(hid, result, finalizer, name)
+        _M_ALLOCATED.inc()
         with self._lock:
             self._handles[hid] = h
         return hid
@@ -103,6 +112,7 @@ class HandleManager:
         with self._lock:
             del self._handles[handle]
         _native.handle_manager_release(self._native, handle)
+        _M_RELEASED.inc()
         return result
 
     def live_count(self) -> int:

@@ -53,6 +53,7 @@ import functools
 import math
 import os
 import sys
+import time
 import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -133,6 +134,13 @@ class GroupSpec:
 @dataclass
 class MegakernelStats:
     builds: int = 0
+    # hvd-telemetry: wall seconds constructing the jitted callables
+    # (trace graph building) and — the dominant cost — the first
+    # dispatch of each cold executable, which is where XLA compiles.
+    # Surfaced as megakernel.build_seconds / megakernel.compile_seconds
+    # gauges by the runtime collector (telemetry/__init__.py).
+    build_seconds: float = 0.0
+    compile_seconds: float = 0.0
     cache_hits: int = 0
     flushes: int = 0
     launches: int = 0
@@ -357,7 +365,8 @@ def _pack_key(shapes, dtype, donate, mesh_key):
 
 
 def _cache_insert(spec: GroupSpec, fn: Callable,
-                  digest: Optional[str] = None) -> None:
+                  digest: Optional[str] = None,
+                  seconds: float = 0.0) -> None:
     """Bounded insert shared by :func:`packer` and :func:`executable`:
     on overflow the whole table clears (wholesale, like the fusion-plan
     memo) rather than aging entries out."""
@@ -372,6 +381,7 @@ def _cache_insert(spec: GroupSpec, fn: Callable,
             _digests[spec] = digest
             _by_digest[digest] = spec
         stats.builds += 1
+        stats.build_seconds += seconds
 
 
 def packer(shapes: Tuple[Tuple[int, ...], ...], dtype: str,
@@ -393,19 +403,25 @@ def packer(shapes: Tuple[Tuple[int, ...], ...], dtype: str,
 
 
 def executable(spec: GroupSpec, mesh,
-               digest_fn: Optional[Callable[[], str]] = None) -> Callable:
+               digest_fn: Optional[Callable[[], str]] = None
+               ) -> Tuple[Callable, bool]:
     """The compiled megakernel for ``spec`` — cached, bounded, recorded
     under its fusion-plan digest on the cold build (``digest_fn`` is
-    only invoked then, keeping the hot path free of hashing)."""
+    only invoked then, keeping the hot path free of hashing).  Returns
+    ``(fn, built)``: ``built`` tells THIS caller whether it did the
+    cold build, so launch() can attribute the first (compiling)
+    dispatch without racing other threads' builds."""
     with _lock:
         fn = _compiled.get(spec)
         if fn is not None:
             stats.cache_hits += 1
-            return fn
+            return fn, False
+    t0 = time.perf_counter()
     fn = _build(spec, mesh)
     _cache_insert(spec, fn,
-                  digest_fn() if digest_fn is not None else None)
-    return fn
+                  digest_fn() if digest_fn is not None else None,
+                  seconds=time.perf_counter() - t0)
+    return fn, True
 
 
 def launch(spec: GroupSpec, mesh, values: Sequence,
@@ -416,13 +432,26 @@ def launch(spec: GroupSpec, mesh, values: Sequence,
     ``stats`` — the "exactly one dispatch per group" regression
     contract — and the donated inputs are recorded as weakrefs for the
     use-after-donate probe."""
-    fn = executable(spec, mesh, digest_fn)
+    fn, cold = executable(spec, mesh, digest_fn)
+
+    def dispatch():
+        # XLA compiles on the cold executable's FIRST dispatch; time
+        # exactly that call (one perf_counter pair, cold path only) so
+        # megakernel.compile_seconds reports real compilation cost.
+        if not cold:
+            return fn(*values)
+        t0 = time.perf_counter()
+        out = fn(*values)
+        with _lock:
+            stats.compile_seconds += time.perf_counter() - t0
+        return out
+
     counting = _xla_dispatch.counting_enabled()
     if counting:
         probes = [weakref.ref(v)
                   for v, d in zip(values, spec.donate) if d]
         with _xla_dispatch.record() as scope:
-            outs = fn(*values)
+            outs = dispatch()
         with _lock:
             stats.launches += 1
             stats.launch_dispatches += scope.count
@@ -431,7 +460,7 @@ def launch(spec: GroupSpec, mesh, values: Sequence,
                 stats.hier_launches += 1
             last_donated[:] = probes
     else:
-        outs = fn(*values)
+        outs = dispatch()
         with _lock:
             stats.launches += 1
             stats.donated_inputs += sum(spec.donate)

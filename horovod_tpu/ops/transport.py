@@ -21,6 +21,7 @@ Frame layout: ``<u32 length><u8 type><payload>`` (little-endian).
 from __future__ import annotations
 
 import atexit
+import json
 import os
 import queue
 import socket
@@ -32,7 +33,9 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from . import wire
+from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
+from ..telemetry import flight as _flight
 from .wire import DEAD_PEER_MARKER, Request, Response, ResponseType
 
 FRAME_HELLO = 0       # worker→controller: <i rank><H len><hostname>
@@ -69,12 +72,40 @@ FRAME_RESPONSE_BATCH = 9  # controller→worker: <I epoch><H ngroups>
                           # reconstitutes the identical fused response
                           # list from its cache replica instead of
                           # re-parsing full Response payloads
+FRAME_METRICS = 10        # hvd-telemetry pull (telemetry/__init__.py):
+                          # controller→worker <I round> requests a
+                          # snapshot; worker→controller <i rank><I round>
+                          # + utf-8 JSON answers it.  Round-keyed like
+                          # FRAME_SIGNATURE so a straggler snapshot from
+                          # a timed-out pull never completes a later one
 
 _HDR = struct.Struct("<IB")
+
+# Control-plane wire telemetry: frames flow at the 5 ms drain cadence
+# (coalesced — that is the PR 2 point), so per-frame accounting is far
+# off the per-request hot path.
+_M_TX = _telemetry.counter("transport.frames_sent")
+_M_TX_BYTES = _telemetry.counter("transport.bytes_sent")
+_M_RX = _telemetry.counter("transport.frames_received")
+_M_RX_BYTES = _telemetry.counter("transport.bytes_received")
+_M_FRAME_BYTES = _telemetry.histogram(
+    "transport.frame_bytes", "bytes", "payload size per control frame")
+_M_BATCH_BITS = _telemetry.counter(
+    "transport.batched_cache_bits", "cache-hit bits coalesced into "
+    "FRAME_REQUEST_BATCH frames")
+_M_BATCH_REQS = _telemetry.counter(
+    "transport.batched_requests", "full requests coalesced into "
+    "FRAME_REQUEST_BATCH frames")
+_M_BATCH_WIDTH = _telemetry.histogram(
+    "transport.batch_width", "count",
+    "items (bits + requests) per coalesced control frame")
 
 
 def _send_frame(sock: socket.socket, ftype: int, payload: bytes = b"") -> None:
     sock.sendall(_HDR.pack(len(payload), ftype) + payload)
+    _M_TX.inc()
+    _M_TX_BYTES.inc(_HDR.size + len(payload))
+    _M_FRAME_BYTES.observe(len(payload))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -95,6 +126,8 @@ def _recv_frame(sock: socket.socket):
     payload = _recv_exact(sock, length) if length else b""
     if length and payload is None:
         return None, None
+    _M_RX.inc()
+    _M_RX_BYTES.inc(_HDR.size + length)
     return ftype, payload
 
 
@@ -155,6 +188,12 @@ class ControllerTransport:
         # guarded_by: _sig_cond
         self._signatures: Dict[int, Dict[int, bytes]] = {}
         self._sig_round = 0  # guarded_by: _sig_cond
+        # hvd-telemetry pull rendezvous: round → rank → decoded
+        # snapshot, same round-keying discipline as the signatures.
+        self._met_cond = threading.Condition(self._lock)
+        # guarded_by: _met_cond
+        self._met_payloads: Dict[int, Dict[int, dict]] = {}
+        self._met_round = 0  # guarded_by: _met_cond
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("0.0.0.0", port))
@@ -220,6 +259,19 @@ class ControllerTransport:
             pass
 
     def _serve(self, rank: int, conn: socket.socket) -> None:
+        # An unhandled exception on a receive thread silently kills the
+        # control plane for that worker; dump the flight ring naming
+        # the thread before the (daemon) thread dies.
+        try:
+            self._serve_inner(rank, conn)
+        except Exception:
+            import traceback
+
+            _telemetry.exception_event(
+                "controller-rx", traceback.format_exc())
+            raise
+
+    def _serve_inner(self, rank: int, conn: socket.socket) -> None:
         while True:
             try:
                 ftype, payload = _recv_frame(conn)
@@ -229,6 +281,7 @@ class ControllerTransport:
                 # EOF without a SHUTDOWN frame = the worker terminated
                 # unexpectedly; the drain loop will poison pending ops.
                 if not (self.shutdown_requested.is_set() or self._closing):
+                    _flight.record("peer_eof", rank)
                     with self._lock:
                         self.lost_ranks.add(rank)
                 return
@@ -254,6 +307,19 @@ class ControllerTransport:
                     self._signatures.setdefault(srnd, {})[srank] = \
                         payload[8:]
                     self._sig_cond.notify_all()
+            elif ftype == FRAME_METRICS:
+                mrank, mrnd = struct.unpack_from("<iI", payload)
+                try:
+                    snap = json.loads(payload[8:].decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    snap = {}
+                with self._met_cond:
+                    # Only rounds with a live waiter accept replies: a
+                    # straggler answer to an abandoned pull must not
+                    # resurrect its round dict (it would leak forever).
+                    if mrnd in self._met_payloads:
+                        self._met_payloads[mrnd][mrank] = snap
+                        self._met_cond.notify_all()
             elif ftype == FRAME_WITHDRAW:
                 (wrank,) = struct.unpack_from("<i", payload)
                 (nlen,) = struct.unpack_from("<H", payload, 4)
@@ -280,6 +346,7 @@ class ControllerTransport:
         off += nbits
         (nreq,) = struct.unpack_from("<H", payload, off)
         off += 2
+        _flight.record("frame_rx_batch", srank, epoch, nreq)
         cache = self.cache
         for byte_i, b in enumerate(bitvec):
             while b:
@@ -392,6 +459,50 @@ class ControllerTransport:
                 except OSError:
                     pass  # worker already gone; its own timeout reports
 
+    # -- hvd-telemetry pull (telemetry/__init__.py cluster_metrics) --------
+    def collect_metrics(self, own: dict,
+                        timeout: float = 10.0) -> Dict[int, dict]:
+        """Pull every rank's metrics snapshot: broadcast a FRAME_METRICS
+        request carrying this round's counter, then wait until every
+        live rank answered (rank 0's snapshot is ``own``).  Returns the
+        snapshots it got — a rank that died or timed out is simply
+        absent (the aggregate's ``ranks`` field records coverage;
+        observability must not fail the job)."""
+        deadline = time.monotonic() + timeout
+        with self._met_cond:
+            self._met_round += 1
+            rnd = self._met_round
+            this_round = self._met_payloads.setdefault(rnd, {})
+            this_round[0] = own
+        payload = struct.pack("<I", rnd)
+        with self._send_lock:
+            with self._lock:
+                conns = list(self._conns.values())
+            for conn in conns:
+                try:
+                    _send_frame(conn, FRAME_METRICS, payload)
+                except OSError:
+                    pass  # worker already gone; absent from the result
+        with self._met_cond:
+            try:
+                while len(this_round) < self.num_processes:
+                    remaining = deadline - time.monotonic()
+                    missing = set(range(self.num_processes)) \
+                        - set(this_round)
+                    if remaining <= 0 or (self.lost_ranks
+                                          and missing <=
+                                          set(self.lost_ranks)):
+                        break
+                    self._met_cond.wait(min(remaining, 0.1))
+                return dict(this_round)
+            finally:
+                # Drop ONLY this round: unlike the signature rendezvous
+                # (lockstep rounds, at most one in flight), concurrent
+                # cluster_metrics() callers each own a round, and a
+                # faster caller must not delete a slower one's dict out
+                # from under its wait loop.
+                self._met_payloads.pop(rnd, None)
+
     # -- controller-side API used by the drain loop ------------------------
     def submit(self, req: Request) -> bool:
         """Rank 0's own submit; returns True when the request was served
@@ -411,6 +522,8 @@ class ControllerTransport:
         return False
 
     def broadcast_responses(self, responses: List[Response]) -> None:
+        _flight.record("bcast_responses", len(responses),
+                       ",".join(r.response_type.name for r in responses))
         payload = wire.pack_response_list(responses)
         # _send_lock serializes whole frames: the drain thread and a
         # shutdown()-calling user thread must not interleave bytes on one
@@ -430,6 +543,7 @@ class ControllerTransport:
         groups (FRAME_RESPONSE_BATCH) — a handful of bytes per tensor
         instead of full Response payloads; each worker reconstitutes the
         identical fused response list from its cache replica."""
+        _flight.record("bcast_replay", epoch, len(groups))
         payload = struct.pack("<IH", epoch, len(groups))
         for g in groups:
             payload += struct.pack("<H", len(g))
@@ -540,6 +654,18 @@ class WorkerTransport:
             pass  # controller already gone
 
     def _recv_loop(self) -> None:
+        # Mirror of the controller's receive-thread guard: dump the
+        # flight ring before an unhandled exception kills the thread.
+        try:
+            self._recv_loop_inner()
+        except Exception:
+            import traceback
+
+            _telemetry.exception_event(
+                "worker-rx", traceback.format_exc())
+            raise
+
+    def _recv_loop_inner(self) -> None:
         while True:
             try:
                 ftype, payload = _recv_frame(self._sock)
@@ -600,6 +726,25 @@ class WorkerTransport:
                 self._sig_results.put(
                     (rnd, None if ok else payload[5:].decode("utf-8")))
                 continue
+            if ftype == FRAME_METRICS:
+                # hvd-telemetry pull: answer with this rank's snapshot,
+                # echoing the round so a slow reply from an abandoned
+                # pull can never complete a later one.  Snapshot +
+                # serialization run on this receive thread — collectors
+                # only read cheap stats structs, nothing blocks.
+                (rnd,) = struct.unpack_from("<I", payload)
+                try:
+                    body = json.dumps(_telemetry.metrics()).encode("utf-8")
+                except Exception:  # noqa: BLE001 — must answer regardless
+                    body = b"{}"
+                with self._send_lock:
+                    try:
+                        _send_frame(self._sock, FRAME_METRICS,
+                                    struct.pack("<iI", self.rank, rnd)
+                                    + body)
+                    except OSError:
+                        pass  # controller gone; its pull times out
+                continue
             if ftype == FRAME_RESPONSES:
                 resps = wire.unpack_response_list(payload)
                 # Controller-initiated shutdown arrives as a SHUTDOWN-type
@@ -648,6 +793,11 @@ class WorkerTransport:
                 by_epoch.setdefault(item[1], []).append(item[2])
             else:
                 reqs.append(item[1])
+        _M_BATCH_REQS.inc(len(reqs))
+        _M_BATCH_BITS.inc(len(items) - len(reqs))
+        _M_BATCH_WIDTH.observe(len(items))
+        _flight.record("frame_tx_batch", len(items) - len(reqs),
+                       len(reqs))
         epochs = sorted(by_epoch) or [0]
         with self._send_lock:
             for i, epoch in enumerate(epochs):

@@ -1,0 +1,181 @@
+"""hvd-telemetry: always-on metrics, cluster aggregation, flight recorder.
+
+Three pieces (docs/metrics.md):
+
+* :mod:`~horovod_tpu.telemetry.registry` — a lock-free-hot-path metrics
+  registry every runtime layer publishes into; ``hvd.metrics()`` is the
+  local snapshot.
+* cluster aggregation — ``hvd.cluster_metrics()`` pulls every rank's
+  snapshot over the control plane (FRAME_METRICS, ops/transport.py) and
+  reports fleet min/max/mean/p50/p90/p99 per metric.  An optional
+  Prometheus/JSON HTTP exporter (``HVD_TPU_METRICS_PORT``) serves
+  ``/metrics`` and ``/healthz`` on rank 0.
+* :mod:`~horovod_tpu.telemetry.flight` — a per-rank ring buffer of
+  recent control-plane events dumped to ``HVD_TPU_FLIGHT_DIR`` on
+  stalls, mismatches, dead peers and drain/receive-thread exceptions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from . import flight  # noqa: F401  (stdlib-only; safe to import first)
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    aggregate,
+    bucket_edges,
+    metrics_enabled,
+)
+
+# Process-global default registry (module import order is unimportant:
+# every layer that instruments itself asks this object for its metric
+# handles at import time).
+_default = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _default
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _default.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _default.gauge(name, help)
+
+
+def histogram(name: str, kind: str = "seconds", help: str = "") -> Histogram:
+    return _default.histogram(name, kind, help)
+
+
+def enabled() -> bool:
+    return _default.enabled
+
+
+def set_enabled(v: bool) -> None:
+    """Master switch for the whole telemetry subsystem (registry AND
+    flight recorder) — the bench's overhead A/B.  Re-enabling restores
+    the flight recorder's own env gate."""
+    _default.set_enabled(v)
+    flight.recorder.enabled = bool(v) and flight.flight_enabled_env()
+
+
+def metrics() -> Dict[str, dict]:
+    """This rank's local metrics snapshot (collectors included)."""
+    return _default.snapshot()
+
+
+def cluster_metrics(timeout: float = 10.0) -> Dict[str, dict]:
+    """Fleet-level aggregation: rank 0 pulls every rank's snapshot over
+    the control plane (FRAME_METRICS) and merges them — min/max/mean
+    (+ per-rank values) for counters/gauges, merged buckets with
+    p50/p90/p99 for histograms.  Rank-0-only in multi-process mode
+    (workers answer the pull automatically from their receive thread —
+    they should call :func:`metrics` for their own local view);
+    single-process mode aggregates the one local snapshot."""
+    from ..core import state as _state
+
+    _state._check_initialized()
+    st = _state.global_state()
+    local = metrics()
+    if not st.multiprocess:
+        return aggregate({0: local})
+    if st.process_index != 0:
+        raise RuntimeError(
+            "cluster_metrics() aggregates on the rank-0 controller; this "
+            "rank answers the controller's FRAME_METRICS pull "
+            "automatically — use hvd.metrics() for its local snapshot.")
+    per_rank = st.transport.collect_metrics(local, timeout=timeout)
+    return aggregate(per_rank)
+
+
+# -- stall/dead-peer helpers shared by coordinator + collective ------------
+
+_M_STALLS = counter(
+    "events.stall_warnings",
+    "stall-watch warnings (tensors pending past the threshold)")
+_M_DEAD_PEERS = counter(
+    "events.dead_peers", "peer processes that died without a handshake")
+_M_DUMPS = counter("flight.dumps", "flight-recorder dumps written")
+
+
+def stall_event(warnings) -> None:
+    """One stall-watch firing: count it, append the full warning text
+    (which names the tensor and the non-ready ranks) to the flight ring,
+    and dump the ring — the 'what happened in the last 2000 events
+    before the stall' forensic record."""
+    ws = list(warnings)
+    if not ws:
+        return
+    _M_STALLS.inc(len(ws))
+    for w in ws:
+        flight.record("stall", w)
+    if flight.dump("stall", extra={"warnings": ws}) is not None:
+        _M_DUMPS.inc()
+
+
+def dead_peer_event(detail: str) -> None:
+    _M_DEAD_PEERS.inc()
+    flight.record("dead_peer", detail)
+    if flight.dump("dead-peer", extra={"detail": detail}) is not None:
+        _M_DUMPS.inc()
+
+
+def error_event(message: str) -> None:
+    flight.record("error", message)
+    if flight.dump("error", extra={"message": message}) is not None:
+        _M_DUMPS.inc()
+
+
+def exception_event(where: str, text: str) -> None:
+    flight.record("exception", where, text)
+    if flight.dump(f"exception-{where}",
+                   extra={"where": where, "traceback": text}) is not None:
+        _M_DUMPS.inc()
+
+
+def install_runtime_collector() -> None:
+    """Register the pull-side collector over the runtime's existing
+    cheap stats structs (CacheStats, MegakernelStats, the handle pool).
+    Idempotent: keyed registration replaces the previous instance on
+    re-init.  Collectors run at snapshot time only — the steady-state
+    hot path never touches these gauges."""
+
+    def collect(reg: MetricsRegistry) -> None:
+        from ..core import state as _state
+        from ..ops import megakernel as _mk
+
+        st = _state.global_state()
+        cache = st.response_cache
+        if cache is not None:
+            s = cache.stats
+            reg.gauge("cache.hits").set(s.hits)
+            reg.gauge("cache.misses").set(s.misses)
+            reg.gauge("cache.flushes").set(s.flushes)
+            reg.gauge("cache.downgrades").set(s.downgrades)
+            reg.gauge("cache.inserts").set(s.inserts)
+            reg.gauge("cache.replayed_tensors").set(s.replayed_tensors)
+            reg.gauge("cache.plan_hits").set(s.plan_hits)
+            reg.gauge("cache.plan_misses").set(s.plan_misses)
+            reg.gauge("cache.entries").set(cache.live_entries())
+            reg.gauge("cache.epoch").set(cache.epoch)
+        hm = st.handle_manager
+        if hm is not None:
+            reg.gauge("handles.live").set(hm.live_count())
+        ms = _mk.stats
+        reg.gauge("megakernel.builds").set(ms.builds)
+        reg.gauge("megakernel.build_seconds").set(
+            round(ms.build_seconds, 6))
+        reg.gauge("megakernel.compile_seconds").set(
+            round(ms.compile_seconds, 6))
+        reg.gauge("megakernel.cache_hits").set(ms.cache_hits)
+        reg.gauge("megakernel.flushes").set(ms.flushes)
+        reg.gauge("megakernel.launches").set(ms.launches)
+        reg.gauge("megakernel.hier_launches").set(ms.hier_launches)
+        reg.gauge("megakernel.executables").set(_mk.cache_size())
+
+    _default.register_collector("runtime", collect)

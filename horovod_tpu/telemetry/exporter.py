@@ -1,0 +1,128 @@
+"""Optional HTTP exporter: Prometheus text / JSON ``/metrics`` + ``/healthz``.
+
+Off by default.  ``HVD_TPU_METRICS_PORT=<port>`` makes ``hvd.init()``
+start one on the rank-0 controller (``HVD_TPU_METRICS_ALL_RANKS=1`` for
+every rank); ``hvd.shutdown()`` stops it.  Tests and embedders can run
+one directly via :func:`start_exporter` (port 0 picks an ephemeral
+port, exposed as ``exporter.port``).
+
+Endpoints:
+  GET /metrics         Prometheus text exposition (``hvd_`` prefix,
+                       histograms as cumulative ``_bucket{le=...}``)
+  GET /metrics?format=json   the raw ``hvd.metrics()`` snapshot
+  GET /healthz         ``{"status": "ok", "rank": r, "initialized": b}``
+
+The server thread only ever *reads* registry snapshots — it takes no
+runtime lock beyond the registry's own leaf, so a wedged control plane
+cannot wedge the health endpoint (that is the point of it).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .registry import MetricsRegistry
+
+_PROM_HELP_TYPES = {"counter": "counter", "gauge": "gauge",
+                    "histogram": "histogram"}
+
+
+def prometheus_name(name: str) -> str:
+    return "hvd_" + "".join(
+        c if (c.isalnum() or c == "_") else "_" for c in name)
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Render one registry snapshot in the Prometheus text exposition
+    format (v0.0.4): counters/gauges as single samples, histograms as
+    cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``."""
+    lines = []
+    for name, m in snapshot.items():
+        pname = prometheus_name(name)
+        mtype = _PROM_HELP_TYPES.get(m.get("type"), "untyped")
+        lines.append(f"# TYPE {pname} {mtype}")
+        if m.get("type") == "histogram":
+            cum = 0
+            for edge, n in m.get("buckets", []):
+                cum += n
+                lines.append(f'{pname}_bucket{{le="{edge:g}"}} {cum}')
+            cum += m.get("overflow", 0)
+            lines.append(f'{pname}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{pname}_sum {m.get('sum', 0)}")
+            lines.append(f"{pname}_count {m.get('count', 0)}")
+        else:
+            lines.append(f"{pname} {m.get('value', 0)}")
+    return "\n".join(lines) + "\n"
+
+
+def _health_payload() -> dict:
+    rank = None
+    initialized = False
+    try:
+        from ..core import state as _state
+
+        st = _state.global_state()
+        initialized = bool(st.initialized)
+        if initialized:
+            rank = st.process_index
+    except Exception:  # noqa: BLE001 — health must answer regardless
+        pass
+    return {"status": "ok", "rank": rank, "initialized": initialized}
+
+
+class MetricsExporter:
+    """A daemon-threaded HTTP server bound to one registry."""
+
+    def __init__(self, registry: MetricsRegistry, port: int,
+                 host: str = "0.0.0.0") -> None:
+        self.registry = registry
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:
+                pass  # no per-request stderr chatter
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
+                    self._reply(200, json.dumps(
+                        _health_payload()).encode(), "application/json")
+                elif path in ("/metrics", "/metrics.json"):
+                    snap = exporter.registry.snapshot()
+                    if path.endswith(".json") or "format=json" in query:
+                        self._reply(200, json.dumps(snap).encode(),
+                                    "application/json")
+                    else:
+                        self._reply(
+                            200, prometheus_text(snap).encode(),
+                            "text/plain; version=0.0.4")
+                else:
+                    self._reply(404, b"not found\n", "text/plain")
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="hvd-metrics-exporter", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=2.0)
+
+
+def start_exporter(registry: MetricsRegistry, port: int,
+                   host: str = "0.0.0.0") -> MetricsExporter:
+    return MetricsExporter(registry, port, host=host)

@@ -33,6 +33,7 @@ import time
 from typing import Optional
 
 from ..native import lib as _native
+from ..telemetry import flight as _flight
 
 # Flush cadence, seconds (≙ TIMELINE_FLUSH_TIME, timeline.h:32).
 _FLUSH_SECONDS = 1.0
@@ -61,6 +62,9 @@ class Timeline:
         if self._native is None:
             self._file = open(path, "w")
             self._file.write("[\n")
+        # Flight-ring breadcrumb: a forensic dump that shows a timeline
+        # was live names the trace file to correlate with.
+        _flight.record("timeline_open", path)
 
     # -- low-level ---------------------------------------------------------
     def _ts_us(self) -> float:
@@ -178,6 +182,7 @@ class Timeline:
         self._event(_PH_END, tensor, args=args or None)
 
     def close(self) -> None:
+        _flight.record("timeline_close", self._path)
         with self._lock:
             if self._native is not None:
                 _native.raw().hvd_timeline_close(self._native)

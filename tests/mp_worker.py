@@ -1034,6 +1034,65 @@ def scenario_cache(hvd):
         print(f"CACHE_OK rank={rank} hits=0 flushes=0")
 
 
+def scenario_metrics(hvd):
+    """hvd-telemetry cluster aggregation over the REAL control plane:
+    both ranks seed negotiation traffic, then rank 0 pulls every
+    rank's snapshot over FRAME_METRICS and asserts the fleet aggregate
+    covers all ranks (rank 1 answers from its receive thread while
+    blocked in its own barrier).
+
+    The seeding uses deliberately MISMATCHED shapes: the full control
+    plane runs — per-rank submits, coalesced frames, rank-0
+    validation, ERROR broadcast — with zero data-plane execution, so
+    this leg (unlike the np>1 XLA-collective legs) also verifies under
+    jax builds whose CPU backend cannot run multiprocess
+    computations."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import HorovodError
+
+    rank = hvd.rank()
+
+    def control_plane_round(name):
+        try:
+            hvd.allreduce(jnp.zeros((2 + rank,), jnp.float32), name=name,
+                          average=False)
+            raise AssertionError(f"mismatched {name} did not raise")
+        except HorovodError as e:
+            assert "Mismatched allreduce tensor shapes" in str(e), str(e)
+
+    for i in range(3):
+        control_plane_round(f"met.{i}")
+
+    local = hvd.metrics()
+    assert local["collective.submitted"]["value"] >= 3, local
+    assert local["collective.errors"]["value"] >= 3, local
+    assert local["collective.negotiate_seconds"]["count"] >= 3, local
+    assert local["transport.frames_sent"]["value"] >= 1, local
+
+    if rank == 0:
+        agg = hvd.cluster_metrics(timeout=30.0)
+        m = agg["collective.submitted"]
+        assert m["ranks"] == hvd.size(), m
+        assert m["min"] >= 3, m
+        assert agg["collective.errors"]["sum"] >= 3 * hvd.size(), agg
+        h = agg["collective.negotiate_seconds"]
+        assert h["count"] >= 3 * hvd.size(), h
+        assert h["p50"] is not None and h["p99"] is not None, h
+        assert agg["transport.frames_sent"]["sum"] >= 2, agg
+    else:
+        try:
+            hvd.cluster_metrics(timeout=1.0)
+            raise AssertionError("cluster_metrics must be rank-0-only")
+        except RuntimeError as e:
+            assert "rank-0" in str(e), str(e)
+    # Barrier keeps rank 1 alive (and answering pulls) until rank 0's
+    # aggregation finished — the mismatch completes negotiation on both
+    # ranks, so it synchronizes without touching the data plane.
+    control_plane_round("met.done")
+    print(f"METRICS_OK rank={rank}")
+
+
 def scenario_combo(hvd):
     """Run several NON-DESTRUCTIVE scenarios sequentially in ONE launch
     (``HVD_TPU_COMBO`` names them, comma-separated).  Every separate

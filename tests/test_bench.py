@@ -54,6 +54,15 @@ def test_bench_control_mode_contract_and_speedup():
     assert payload["metric"] == "control_plane_negotiations_per_sec"
     assert payload["cache_on"] > 0 and payload["cache_off"] > 0
     assert payload["speedup"] >= 1.5, payload
+    # hvd-telemetry overhead A/B rides the JSON (ISSUE 4 gate): both
+    # rates present, the pct computed, and the counters attached.  The
+    # ok-boolean itself is asserted by CI on a quiet box, not here — a
+    # loaded tier-1 machine can fake either direction.
+    tel = payload["telemetry"]
+    assert tel["cache_on_metrics_on"] > 0
+    assert tel["cache_on_metrics_off"] > 0
+    assert "overhead_pct" in tel and "overhead_ok" in tel
+    assert isinstance(tel["counters"], dict)
 
 
 def test_bench_dataplane_mode_contract_and_gates():
@@ -84,6 +93,12 @@ def test_bench_dataplane_mode_contract_and_gates():
     assert payload["dispatch_reduction"] >= 2.0, payload
     assert payload["bitwise_identical"] is True, payload
     assert payload["hierarchical_equal"] is True, payload
+    # hvd-telemetry overhead A/B rides this JSON too (ISSUE 4): the
+    # megakernel counters must show real launches were accounted.
+    tel = payload["telemetry"]
+    assert tel["megakernel_us_metrics_off"] > 0
+    assert "overhead_pct" in tel
+    assert tel["counters"].get("megakernel.launches", 0) >= 1, tel
 
 
 @pytest.mark.slow

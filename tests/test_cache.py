@@ -384,18 +384,34 @@ def test_two_rank_wire_fast_path_bits_and_compact_replay():
             worker.flush_requests()
             for name in names:
                 ctrl.submit(_req(0, name))
+            # Tick until EVERY name's negotiation completed: the
+            # controller's receive thread may be mid-batch when a tick
+            # polls, legally splitting one cycle's responses across two
+            # ticks/broadcasts (the protocol delivers both; only this
+            # test's bookkeeping must not stop at the first).
             deadline = time.monotonic() + 5.0
             resps = []
+            want = {n for n in names}
             while time.monotonic() < deadline:
-                resps = controller_tick()
-                if resps:
+                resps += controller_tick()
+                seen = {n for r in resps for n in r.tensor_names}
+                if want <= seen:
                     break
                 time.sleep(0.005)
             assert resps, "controller tick produced nothing"
-            got = worker_recv()
-            for r in got:
-                wrk_cache.observe_response(r, own_requests={
-                    1: wreqs})
+            got = []
+            end = time.monotonic() + 5.0
+            while time.monotonic() < end:
+                batch = worker.poll_responses()
+                if batch is not None:
+                    got += batch
+                    for r in batch:
+                        wrk_cache.observe_response(r, own_requests={
+                            1: wreqs})
+                    if want <= {n for r in got for n in r.tensor_names}:
+                        break
+                time.sleep(0.005)
+            assert got, "worker never received the broadcast"
             return resps, got
 
         # Cycle 1: cold — full requests, negotiated responses, replicas

@@ -51,22 +51,38 @@ def test_two_process_scenarios_combined(tmp_path):
     pytest.importorskip("torch")
     pytest.importorskip("tensorflow")
     tl = tmp_path / "timeline.json"
-    combo = ("basic,mismatch,spmd_train,stall,withdraw,join,checkpoint,"
-             "torch_frontend,tf_function")
+    flight_dir = tmp_path / "flight"
+    combo = ("basic,mismatch,spmd_train,metrics,stall,withdraw,join,"
+             "checkpoint,torch_frontend,tf_function")
     t0 = _time.monotonic()
     out = _launch("combo", extra_env={
         "HVD_TPU_COMBO": combo,
         "HOROVOD_STALL_WARNING_SECONDS": "1.5",
         "HVD_TPU_TEST_CKPT": str(tmp_path / "ck.msgpack"),
         "HOROVOD_TIMELINE": str(tl),
+        "HVD_TPU_FLIGHT_DIR": str(flight_dir),
     }, timeout=600.0)
-    for marker in ("BASIC_OK", "MISMATCH_OK", "SPMD_OK", "STALL_OK",
-                   "WITHDRAW_OK", "JOIN_OK", "CKPT_OK", "TORCH_OK",
-                   "TFFN_OK", "COMBO_OK"):
+    for marker in ("BASIC_OK", "MISMATCH_OK", "SPMD_OK", "METRICS_OK",
+                   "STALL_OK", "WITHDRAW_OK", "JOIN_OK", "CKPT_OK",
+                   "TORCH_OK", "TFFN_OK", "COMBO_OK"):
         assert f"{marker} rank=0" in out, (marker, out)
         assert f"{marker} rank=1" in out, (marker, out)
     # The rank-0 coordinator named the late rank while stalled.
     assert "waiting on replicas: [1]" in out
+    # The stall also dumped the flight recorder on rank 0, and the
+    # dump's tail names the stalled tensor and the non-ready rank
+    # (ISSUE 4 acceptance: the seeded stall in the slow mp leg).
+    import glob as _glob
+
+    stall_dumps = sorted(_glob.glob(
+        str(flight_dir / "hvd_flight_rank0_*stall*.json")))
+    assert stall_dumps, sorted(_glob.glob(str(flight_dir / "*")))
+    payload = _json.loads(open(stall_dumps[-1]).read())
+    stall_events = [e for e in payload["events"]
+                    if e["kind"] == "stall"]
+    assert stall_events, payload["events"][-5:]
+    assert "late.op" in stall_events[-1]["args"][0]
+    assert "waiting on replicas: [1]" in stall_events[-1]["args"][0]
     # The withdraw legs failed fast (well under one 300 s timeout).
     assert _time.monotonic() - t0 < 300.0
     # Timeline recorded negotiation events (rank-0-only writer).
@@ -93,6 +109,27 @@ def test_verify_program_divergence_diagnostics():
             assert f"VERIFY_DIVERGE_OK rank={rank} case={case}" in out, \
                 (case, out)
         assert f"VERIFY_ALL_OK rank={rank}" in out, out
+
+
+@pytest.mark.slow
+def test_two_process_cluster_metrics(tmp_path):
+    """hvd-telemetry over REAL processes: cluster_metrics() on rank 0
+    aggregates both ranks' snapshots over FRAME_METRICS (seeded with
+    control-plane-only traffic, so this leg runs under any jax build —
+    like the shutdown/verify legs), and the error dumps land in
+    HVD_TPU_FLIGHT_DIR on both ranks."""
+    import glob as _glob
+
+    flight_dir = tmp_path / "flight"
+    out = _launch("metrics", extra_env={
+        "HVD_TPU_FLIGHT_DIR": str(flight_dir)}, timeout=300.0)
+    assert "METRICS_OK rank=0" in out, out
+    assert "METRICS_OK rank=1" in out, out
+    # The seeded mismatches dumped the flight ring on both ranks.
+    for rank in (0, 1):
+        dumps = _glob.glob(
+            str(flight_dir / f"hvd_flight_rank{rank}_*error*.json"))
+        assert dumps, (rank, sorted(_glob.glob(str(flight_dir / "*"))))
 
 
 @pytest.mark.slow

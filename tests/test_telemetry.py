@@ -1,0 +1,395 @@
+"""hvd-telemetry unit tests (docs/metrics.md).
+
+Covers the registry semantics (log2 histogram buckets, exact totals
+under concurrent writers, the disabled-path no-op), the cluster
+aggregation math, the Prometheus/JSON exporter endpoint contract, and
+the flight recorder — including dumps produced by a SEEDED stall and a
+SEEDED cross-rank mismatch through the real coordinator paths.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu import telemetry
+from horovod_tpu.telemetry import exporter as tel_exporter
+from horovod_tpu.telemetry import flight as tel_flight
+from horovod_tpu.telemetry.registry import (MetricsRegistry, aggregate,
+                                            bucket_edges)
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_basics():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("c", "help text")
+    c.inc()
+    c.inc(41)
+    g = reg.gauge("g")
+    g.set(2.5)
+    snap = reg.snapshot()
+    assert snap["c"] == {"type": "counter", "value": 42}
+    assert snap["g"] == {"type": "gauge", "value": 2.5}
+    # get-or-create returns the same object; a kind clash raises.
+    assert reg.counter("c") is c
+    with pytest.raises(TypeError):
+        reg.gauge("c")
+    with pytest.raises(TypeError):
+        reg.histogram("g", "seconds")
+
+
+def test_histogram_log2_buckets():
+    reg = MetricsRegistry(enabled=True)
+    h = reg.histogram("h", "count")
+    edges = bucket_edges("count")
+    assert edges[0] == 1.0 and edges[-1] == 4096.0
+    # Value -> smallest power-of-two edge covering it.
+    for v, expect_le in ((1, 1.0), (2, 2.0), (3, 4.0), (4, 4.0),
+                         (5, 8.0), (4096, 4096.0), (0, 1.0)):
+        h.observe(v)
+        snap = h.snapshot()
+        counts = dict((le, n) for le, n in snap["buckets"])
+        assert counts[expect_le] >= 1, (v, expect_le, snap)
+    snap = h.snapshot()
+    assert snap["count"] == 7
+    assert snap["overflow"] == 0
+    h.observe(5000)  # past the last edge
+    assert h.snapshot()["overflow"] == 1
+
+
+def test_histogram_seconds_microsecond_floor():
+    reg = MetricsRegistry(enabled=True)
+    h = reg.histogram("lat", "seconds")
+    h.observe(1e-9)   # below the smallest edge: clamps into bucket 0
+    h.observe(0.5)
+    h.observe(100.0)  # past 32 s: overflow
+    snap = h.snapshot()
+    assert snap["count"] == 3
+    assert snap["buckets"][0][1] == 1
+    assert snap["overflow"] == 1
+    assert snap["sum"] == pytest.approx(100.5, rel=1e-6)
+
+
+def test_concurrent_writers_are_exact():
+    """The striped per-thread cells make totals EXACT under concurrent
+    writers — no lost increments, no torn histogram rows."""
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("c")
+    h = reg.histogram("h", "count")
+    threads_n, per_thread = 8, 20_000
+
+    def work():
+        for i in range(per_thread):
+            c.inc()
+            h.observe((i % 7) + 1)
+
+    threads = [threading.Thread(target=work) for _ in range(threads_n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["c"]["value"] == threads_n * per_thread
+    assert snap["h"]["count"] == threads_n * per_thread
+    assert sum(n for _le, n in snap["h"]["buckets"]) == \
+        threads_n * per_thread
+
+
+def test_disabled_registry_is_a_noop():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("c")
+    h = reg.histogram("h", "seconds")
+    g = reg.gauge("g")
+    c.inc(5)
+    h.observe(1.0)
+    g.set(3)
+    snap = reg.snapshot()
+    assert snap["c"]["value"] == 0
+    assert snap["h"]["count"] == 0
+    assert snap["g"]["value"] == 0
+    # Runtime re-enable works (the bench A/B path).
+    reg.set_enabled(True)
+    c.inc(5)
+    assert reg.snapshot()["c"]["value"] == 5
+
+
+def test_set_enabled_master_switch_silences_flight(monkeypatch):
+    was = telemetry.enabled()
+    try:
+        telemetry.set_enabled(False)
+        n0 = len(tel_flight.snapshot())
+        tel_flight.record("should_not_appear")
+        assert len(tel_flight.snapshot()) == n0
+        telemetry.set_enabled(True)
+        tel_flight.record("appears")
+        assert tel_flight.snapshot()[-1][1] == "appears"
+    finally:
+        telemetry.set_enabled(was)
+
+
+def test_collectors_run_at_snapshot_and_never_break_it():
+    reg = MetricsRegistry(enabled=True)
+    calls = []
+
+    def ok(r):
+        calls.append(1)
+        r.gauge("pull.g").set(7)
+
+    def broken(r):
+        raise RuntimeError("collector bug")
+
+    reg.register_collector("ok", ok)
+    reg.register_collector("broken", broken)
+    snap = reg.snapshot()
+    assert calls and snap["pull.g"]["value"] == 7
+    reg.unregister_collector("ok")
+    reg.snapshot()
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# Aggregation
+# ---------------------------------------------------------------------------
+
+def _mk_snapshot(counter_v, hist_values):
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("c").inc(counter_v)
+    h = reg.histogram("h", "count")
+    for v in hist_values:
+        h.observe(v)
+    return reg.snapshot()
+
+def test_aggregate_scalars_and_histograms():
+    snaps = {0: _mk_snapshot(10, [1, 2, 4]),
+             1: _mk_snapshot(30, [8, 16, 32])}
+    agg = aggregate(snaps)
+    c = agg["c"]
+    assert c["ranks"] == 2 and c["min"] == 10 and c["max"] == 30
+    assert c["mean"] == 20 and c["sum"] == 40
+    assert c["per_rank"] == {0: 10, 1: 30}
+    h = agg["h"]
+    assert h["ranks"] == 2 and h["count"] == 6
+    assert h["mean"] == pytest.approx(63 / 6)
+    assert h["p50"] == 4.0       # 3rd of 6 observations
+    assert h["p99"] == 32.0
+    # A metric present on one rank only still aggregates.
+    snaps[1]["only1"] = {"type": "gauge", "value": 5}
+    agg = aggregate(snaps)
+    assert agg["only1"]["ranks"] == 1 and agg["only1"]["mean"] == 5
+
+
+# ---------------------------------------------------------------------------
+# Exporter endpoint contract
+# ---------------------------------------------------------------------------
+
+def test_exporter_endpoints():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("exp.count").inc(3)
+    reg.histogram("exp.lat", "seconds").observe(0.25)
+    exp = tel_exporter.start_exporter(reg, 0, host="127.0.0.1")
+    try:
+        base = f"http://127.0.0.1:{exp.port}"
+        text = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=5).read().decode()
+        assert "# TYPE hvd_exp_count counter" in text
+        assert "hvd_exp_count 3" in text
+        assert 'hvd_exp_lat_bucket{le="+Inf"} 1' in text
+        assert "hvd_exp_lat_count 1" in text
+
+        js = json.loads(urllib.request.urlopen(
+            f"{base}/metrics?format=json", timeout=5).read())
+        assert js["exp.count"]["value"] == 3
+
+        health = urllib.request.urlopen(f"{base}/healthz",
+                                        timeout=5)
+        assert health.status == 200
+        assert json.loads(health.read())["status"] == "ok"
+
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        exp.close()
+
+
+def test_exporter_started_by_init_on_env_port(monkeypatch):
+    import jax
+
+    import horovod_tpu as hvd
+
+    monkeypatch.setenv("HVD_TPU_METRICS_PORT", "0")
+    hvd.init(devices=jax.devices())
+    try:
+        from horovod_tpu.core import state as state_mod
+
+        exp = state_mod.global_state().metrics_exporter
+        assert exp is not None
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.port}/healthz", timeout=5).read())
+        assert health["initialized"] is True and health["rank"] == 0
+    finally:
+        hvd.shutdown()
+    assert state_mod.global_state().metrics_exporter is None
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_is_bounded():
+    rec = tel_flight.FlightRecorder(capacity=100, enabled=True)
+    for i in range(250):
+        rec.record("tick", i)
+    events = rec.snapshot()
+    assert len(events) == 100
+    assert events[0][2] == (150,) and events[-1][2] == (249,)
+
+
+def test_flight_dump_format_and_rate_limit(tmp_path):
+    rec = tel_flight.FlightRecorder(capacity=10, enabled=True)
+    rec.record("submit", "grad.0", 0)
+    rec.record("stall", "Tensor grad.0 pending")
+    path = rec.dump("unit-test", extra={"k": "v"},
+                    directory=str(tmp_path))
+    assert path is not None
+    payload = json.loads(open(path).read())
+    assert payload["format"] == "hvd-flight-v1"
+    assert payload["reason"] == "unit-test"
+    assert payload["extra"] == {"k": "v"}
+    assert payload["events"][-1]["kind"] == "stall"
+    # Same reason inside the rate window: suppressed.
+    assert rec.dump("unit-test", directory=str(tmp_path)) is None
+    # Different reason: allowed.
+    assert rec.dump("other", directory=str(tmp_path)) is not None
+    # No directory configured: no-op, never raises.
+    assert rec.dump("unit-test") is None or tel_flight.flight_dir()
+
+
+def test_flight_dump_on_seeded_stall(monkeypatch, tmp_path):
+    """A stall through the REAL coordinator facade produces a flight
+    dump whose tail names the stalled tensor and the non-ready ranks
+    (the acceptance contract of ISSUE 4)."""
+    from horovod_tpu.ops import coordinator as coord_mod
+    from horovod_tpu.ops import wire
+
+    monkeypatch.setenv("HVD_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setattr(coord_mod, "STALL_WARNING_SECONDS", -1.0)
+    tel_flight.recorder._last_dump.pop("stall", None)
+    stalls0 = telemetry.registry().snapshot(
+        run_collectors=False)["events.stall_warnings"]["value"]
+
+    coord = coord_mod.Coordinator(size=2, fusion_threshold=1 << 20)
+    coord.submit(wire.Request(
+        request_rank=0, request_type=wire.RequestType.ALLREDUCE,
+        tensor_type=wire.DataType.FLOAT32, tensor_name="stalled.op",
+        tensor_shape=(4,)))
+    resps = coord.poll_responses({})  # rank 1 never submitted
+    assert resps == []
+    coord.close()
+
+    snap = telemetry.registry().snapshot(run_collectors=False)
+    assert snap["events.stall_warnings"]["value"] > stalls0
+    files = sorted(tmp_path.glob("hvd_flight_*stall*.json"))
+    assert files, list(tmp_path.iterdir())
+    payload = json.loads(files[-1].read_text())
+    stall_events = [e for e in payload["events"] if e["kind"] == "stall"]
+    assert stall_events, payload["events"][-5:]
+    tail = stall_events[-1]["args"][0]
+    assert "stalled.op" in tail, tail
+    assert "waiting on replicas: [1]" in tail, tail
+    # The ring also shows the submit that started the stalled op.
+    assert any(e["kind"] == "submit" and "stalled.op" in e["args"]
+               for e in payload["events"])
+    assert payload["extra"]["warnings"]
+
+
+def test_flight_dump_on_seeded_mismatch(hvd, monkeypatch, tmp_path):
+    """A cross-rank shape mismatch through the real validation path
+    dumps the ring with the full diagnostic."""
+    from horovod_tpu.ops import collective as C
+    from horovod_tpu.ops import wire
+    from horovod_tpu.ops.coordinator import PyCoordinator
+
+    monkeypatch.setenv("HVD_TPU_FLIGHT_DIR", str(tmp_path))
+    tel_flight.recorder._last_dump.pop("error", None)
+
+    coord = PyCoordinator(size=2, fusion_threshold=1 << 20)
+    for rank, shape in ((0, (2,)), (1, (3,))):
+        coord.submit(wire.Request(
+            request_rank=rank, request_type=wire.RequestType.ALLREDUCE,
+            tensor_type=wire.DataType.FLOAT32, tensor_name="bad.shape",
+            tensor_shape=shape))
+    errs = [r for r in coord.poll_responses({})
+            if r.response_type == wire.ResponseType.ERROR]
+    assert errs and "Mismatched allreduce tensor shapes" in \
+        errs[0].error_message
+    C._execute_response(errs[0], [])
+
+    files = sorted(tmp_path.glob("hvd_flight_*error*.json"))
+    assert files, list(tmp_path.iterdir())
+    payload = json.loads(files[-1].read_text())
+    assert "Mismatched allreduce tensor shapes" in \
+        payload["extra"]["message"]
+
+
+# ---------------------------------------------------------------------------
+# End-to-end local metrics + single-process cluster aggregation
+# ---------------------------------------------------------------------------
+
+def test_metrics_snapshot_after_collectives(hvd):
+    base = hvd.metrics()
+    out = hvd.allreduce(np.ones((8,), np.float32), average=False,
+                        name="tel.e2e")
+    np.testing.assert_allclose(np.asarray(out)[0], hvd.size())
+    snap = hvd.metrics()
+    assert snap["collective.submitted"]["value"] > \
+        base["collective.submitted"]["value"]
+    assert snap["collective.completed"]["value"] > \
+        base["collective.completed"]["value"]
+    assert snap["collective.negotiate_seconds"]["count"] >= 1
+    assert snap["collective.payload_bytes"]["count"] >= 1
+    assert snap["fusion.group_width"]["count"] >= 1
+    # Pull-side gauges from the runtime collector.
+    assert "handles.live" in snap
+    assert "megakernel.builds" in snap
+    assert "cache.hits" in snap  # response cache on by default
+
+
+def test_cluster_metrics_single_process(hvd):
+    hvd.allreduce(np.ones((4,), np.float32), average=False,
+                  name="tel.agg")
+    agg = hvd.cluster_metrics()
+    m = agg["collective.submitted"]
+    assert m["ranks"] == 1 and m["sum"] >= 1
+    h = agg["collective.negotiate_seconds"]
+    assert h["count"] >= 1 and h["p50"] is not None
+
+
+# ---------------------------------------------------------------------------
+# Bounded kernel caches (ISSUE 4 satellite: ops/collective.py)
+# ---------------------------------------------------------------------------
+
+def test_kernel_cache_evicts_stale_device_entries(hvd):
+    """Entries keyed on Device objects that no longer appear in
+    jax.devices() (a restarted backend) are evicted on the next miss
+    instead of living forever (the old unbounded lru_cache)."""
+    import jax
+
+    from horovod_tpu.ops import collective as C
+
+    fake_key = ("dead-device-0", "dead-device-1")
+    fresh_key = tuple(jax.devices()[:3])
+    with C._kernel_cache_lock:
+        C._kernel_caches["replica"][fake_key] = {"stale": None}
+        C._kernel_caches["subset"].pop(fresh_key, None)  # force a miss
+    mesh, ks = C._subset_kernels(fresh_key)
+    assert "psum_pr" in ks
+    with C._kernel_cache_lock:
+        assert fake_key not in C._kernel_caches["replica"]
+        assert fresh_key in C._kernel_caches["subset"]
+    # Live entries survive (same-backend re-inits share compilations).
+    assert C._subset_kernels(fresh_key)[1] is ks
