@@ -331,6 +331,35 @@ def init(devices=None) -> None:
             name="horovod_tpu-tick", daemon=True)
         _state.bg_thread.start()
 
+    # Persistent compile cache (hvd-pipeline; OUTSIDE the state lock —
+    # warm_start compiles and touches the filesystem): point jax's XLA
+    # compilation cache at HVD_TPU_COMPILE_CACHE_DIR and AOT-rebuild the
+    # megakernel executables the previous incarnation recorded there, so
+    # an elastic relaunch (or any repeat run) skips the cold-compile
+    # stall on its first training steps.
+    cache_dir = os.environ.get("HVD_TPU_COMPILE_CACHE_DIR")
+    if cache_dir:
+        _configure_compile_cache(cache_dir)
+        from ..ops import megakernel as _megakernel
+
+        _megakernel.warm_start(_state.mesh, cache_dir)
+
+
+def _configure_compile_cache(directory: str) -> None:
+    """Point jax's persistent XLA compilation cache at ``directory``
+    (idempotent; thresholds dropped to zero so even small steady-state
+    executables — the megakernels — persist).  Unknown options on older
+    jax are skipped: the cache is an optimization, never a hard dep."""
+    os.makedirs(directory, exist_ok=True)
+    for option, value in (
+            ("jax_compilation_cache_dir", directory),
+            ("jax_persistent_cache_min_compile_time_secs", 0),
+            ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(option, value)
+        except (AttributeError, ValueError):  # pragma: no cover - old jax
+            pass
+
 
 def shutdown() -> None:
     """Cooperative shutdown (≙ operations.cc:1377-1442, :1456-1474).

@@ -130,6 +130,7 @@ class State:
         object.__setattr__(self, "_snapshot", None)
         object.__setattr__(self, "_reset_callbacks", [])
         object.__setattr__(self, "_commit_serial", 0)
+        object.__setattr__(self, "_commit_write", None)
         # Pre-commit snapshot so restore() before any commit() returns to
         # the constructed state rather than failing.
         self._snapshot_now()
@@ -163,10 +164,15 @@ class State:
 
         Every rank keeps a host-memory snapshot; when
         ``HVD_TPU_ELASTIC_DIR`` is set (the elastic launcher exports it)
-        the coordinating process also publishes to disk — atomic
-        write-then-rename, same discipline as
-        :func:`.utils.checkpoint.save_checkpoint` — so the commit
-        survives a full job restart.
+        the coordinating process also publishes to disk so the commit
+        survives a full job restart.  The disk write rides the
+        background checkpoint writer (``utils/checkpoint``): commit()
+        returns after the host snapshot — the training loop never waits
+        on the filesystem — while the writer publishes with the same
+        atomic tmp+rename discipline, in commit order.
+        :meth:`wait_committed` is the explicit durability point;
+        :meth:`sync` and a normal interpreter exit fence pending writes
+        automatically.
         """
         self._snapshot_now()
         object.__setattr__(self, "_commit_serial", self._commit_serial + 1)
@@ -177,15 +183,22 @@ class State:
 
         if _state.is_initialized() and _state.process_index() != 0:
             return
-        from flax import serialization
+        from .utils import checkpoint as _checkpoint
 
-        os.makedirs(d, exist_ok=True)
-        path = os.path.join(d, _STATE_FILE)
-        blob = serialization.to_bytes(self._snapshot)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, path)
+        # The snapshot is already a fresh host copy (_host_copy): safe
+        # to hand to the writer thread as-is — restore()/sync() never
+        # mutate it in place, they copy out of it.
+        object.__setattr__(self, "_commit_write",
+                           _checkpoint.write_tree_async(
+                               os.path.join(d, _STATE_FILE),
+                               self._snapshot))
+
+    def wait_committed(self, timeout: Optional[float] = None) -> bool:
+        """Block until the most recent :meth:`commit`'s disk publish is
+        durable (no-op when commits are host-memory only).  Re-raises a
+        writer failure as :class:`.utils.checkpoint.CheckpointError`."""
+        w = self._commit_write
+        return True if w is None else w.wait(timeout)
 
     def restore(self) -> None:
         """Roll back to the last :meth:`commit` (or the constructed
@@ -214,6 +227,13 @@ class State:
 
         d = _elastic_dir()
         path = os.path.join(d, _STATE_FILE) if d else None
+        if path and (not _state.is_initialized()
+                     or _state.process_index() == 0):
+            # Fence this process's own in-flight commit publish first:
+            # sync() must converge on the newest commit, not race it.
+            from .utils import checkpoint as _checkpoint
+
+            _checkpoint.wait_for_writes()
         if path and os.path.exists(path) and (
                 not _state.is_initialized()
                 or _state.process_index() == 0):

@@ -25,8 +25,9 @@ import numpy as np
 import optax
 
 from ..core import state as _state
-from ..parallel.training import (make_train_step, make_train_step_with_state,
-                                 shard_batch)
+from ..parallel.input import prefetch_to_device
+from ..parallel.training import (barrier_fence, make_train_step,
+                                 make_train_step_with_state, shard_batch)
 
 
 class Trainer:
@@ -179,7 +180,9 @@ class Trainer:
                 fn(*args)
 
     def fit(self, batches: Callable[[int, int], Any], epochs: int,
-            steps_per_epoch: int, initial_epoch: int = 0) -> List[dict]:
+            steps_per_epoch: int, initial_epoch: int = 0,
+            prefetch: int = 2,
+            log_every: Optional[int] = None) -> List[dict]:
         """Run the loop.  ``batches(epoch, step)`` returns one global batch
         (leading axis divisible by the replica count).
 
@@ -187,7 +190,25 @@ class Trainer:
         restore so epoch-indexed callbacks (warmup, schedules) continue
         where they left off — the reference example passes the broadcast
         ``resume_from_epoch`` to Keras ``fit`` the same way
-        (examples/keras_imagenet_resnet50.py:130-133)."""
+        (examples/keras_imagenet_resnet50.py:130-133).
+
+        The loop is host-overlapped (hvd-pipeline): each epoch's batches
+        stage host→device through :func:`..parallel.input
+        .prefetch_to_device` (``prefetch`` = queue depth; 0 restores the
+        synchronous per-step ``shard_batch``), and the step's outputs
+        are NOT fetched per step — losses stay device arrays until the
+        epoch-end log (JAX's async dispatch then pipelines step N+1's
+        launch under step N's execution).  ``log_every=k`` additionally
+        fetches the current loss every k steps and hands it to the
+        callbacks' ``on_batch_end`` logs — an explicit, bounded
+        synchronization point for progress reporting.
+
+        NOTE with ``prefetch>0`` the ``batches`` callable runs on a
+        background stager thread, up to ``prefetch+1`` steps AHEAD of
+        (and concurrent with) the step/callback sequence.  If it is not
+        thread-safe, or reads state the callbacks mutate per batch
+        (curriculum keyed on ``trainer.lr`` etc.), pass ``prefetch=0``.
+        """
         self.steps_per_epoch = steps_per_epoch
         self._call("on_train_begin", None)
         for epoch in range(initial_epoch, epochs):
@@ -195,31 +216,55 @@ class Trainer:
                 break
             self._call("on_epoch_begin", epoch, None)
             losses = []
-            for step in range(steps_per_epoch):
-                self._call("on_batch_begin", step, None)
-                batch = shard_batch(batches(epoch, step))
-                if self._fsdp:
-                    # The hot loop runs on the shard directly — no
-                    # per-step gather through the params property.
-                    if self._has_state:
-                        (self._p_shard, self.model_state, self.opt_state,
-                         loss) = self._step(self._p_shard,
-                                            self.model_state,
+
+            def epoch_batches(epoch=epoch):
+                for s in range(steps_per_epoch):
+                    yield batches(epoch, s)
+
+            if prefetch and prefetch > 0:
+                staged = prefetch_to_device(epoch_batches(), depth=prefetch)
+            else:
+                staged = (shard_batch(b) for b in epoch_batches())
+            try:
+                for step, batch in enumerate(staged):
+                    self._call("on_batch_begin", step, None)
+                    if self._fsdp:
+                        # The hot loop runs on the shard directly — no
+                        # per-step gather through the params property.
+                        if self._has_state:
+                            (self._p_shard, self.model_state,
+                             self.opt_state, loss) = self._step(
+                                 self._p_shard, self.model_state,
+                                 self.opt_state, batch)
+                        else:
+                            (self._p_shard, self.opt_state,
+                             loss) = self._step(self._p_shard,
+                                                self.opt_state, batch)
+                    elif self._has_state:
+                        (self.params, self.model_state, self.opt_state,
+                         loss) = self._step(self.params, self.model_state,
                                             self.opt_state, batch)
                     else:
-                        self._p_shard, self.opt_state, loss = self._step(
-                            self._p_shard, self.opt_state, batch)
-                elif self._has_state:
-                    (self.params, self.model_state, self.opt_state,
-                     loss) = self._step(self.params, self.model_state,
-                                        self.opt_state, batch)
-                else:
-                    self.params, self.opt_state, loss = self._step(
-                        self.params, self.opt_state, batch)
-                losses.append(loss)
-                self._call("on_batch_end", step, None)
-            logs = {"loss": float(np.mean([float(l) for l in losses]))}
+                        self.params, self.opt_state, loss = self._step(
+                            self.params, self.opt_state, batch)
+                    losses.append(loss)
+                    batch_logs = None
+                    if log_every and (step + 1) % log_every == 0:
+                        # The only per-step fetch, at the caller-chosen
+                        # cadence (≙ the deferred-fetch contract of
+                        # docs/performance.md).
+                        batch_logs = {"loss": float(np.asarray(loss))}
+                    self._call("on_batch_end", step, batch_logs)
+            finally:
+                close = getattr(staged, "close", None)
+                if close is not None:
+                    close()
+            # ONE deferred fetch for the whole epoch instead of a
+            # float() sync per step.
+            logs = {"loss": float(np.mean(
+                [np.asarray(l) for l in jax.device_get(losses)]))}
             self._call("on_epoch_end", epoch, logs)
             self.history.append(logs)
+        barrier_fence()
         self._call("on_train_end", None)
         return self.history

@@ -58,9 +58,11 @@ import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+import json
+
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..analysis import lockorder as _lockorder
 from ..analysis import program as _program
@@ -78,6 +80,17 @@ from .wire import ReduceOp
 CACHE_CAPACITY = 128
 
 DCN_COMPRESS_ENV = "HVD_TPU_DCN_COMPRESS"
+
+# Persistent compile cache (hvd-pipeline): when set, (a) jax's XLA
+# compilation cache persists to this directory (wired by core/state.init)
+# and (b) every cold megakernel build appends its group structure to
+# <dir>/megakernel_manifest.json, so an elastic relaunch — or any repeat
+# run — can AOT-rebuild the steady-state executables at init time
+# (:func:`warm_start`) and hit the disk cache instead of recompiling on
+# the first training step.
+COMPILE_CACHE_ENV = "HVD_TPU_COMPILE_CACHE_DIR"
+MANIFEST_NAME = "megakernel_manifest.json"
+MANIFEST_CAP = 256
 
 _enabled_override: Optional[bool] = None
 
@@ -151,6 +164,12 @@ class MegakernelStats:
     launch_dispatches: int = 0
     hier_launches: int = 0
     donated_inputs: int = 0
+    # Executables AOT-rebuilt from the persistent-cache manifest at
+    # init (warm_start) and the wall seconds it took — on a relaunch
+    # with a warm XLA disk cache this is the recompile time saved from
+    # the first training step.
+    warm_starts: int = 0
+    warm_seconds: float = 0.0
 
 
 stats = MegakernelStats()
@@ -418,10 +437,145 @@ def executable(spec: GroupSpec, mesh,
             return fn, False
     t0 = time.perf_counter()
     fn = _build(spec, mesh)
-    _cache_insert(spec, fn,
-                  digest_fn() if digest_fn is not None else None,
+    digest = digest_fn() if digest_fn is not None else None
+    _cache_insert(spec, fn, digest,
                   seconds=time.perf_counter() - t0)
+    _record_manifest(spec, digest)  # cold builds only; no-op without env
     return fn, True
+
+
+# ---------------------------------------------------------------------------
+# Persistent compile cache: manifest + AOT warm start (hvd-pipeline)
+# ---------------------------------------------------------------------------
+
+def compile_cache_dir() -> Optional[str]:
+    return os.environ.get(COMPILE_CACHE_ENV) or None
+
+
+def _mesh_fingerprint(mesh_key) -> dict:
+    d0 = mesh_key[0]
+    return {"platform": getattr(d0, "platform", "?"),
+            "device_kind": getattr(d0, "device_kind", "?"),
+            "count": len(mesh_key)}
+
+
+def _manifest_entry(spec: GroupSpec, digest: Optional[str]) -> dict:
+    return {
+        "variant": spec.variant,
+        "op": spec.op,
+        "average": spec.average,
+        "denom": spec.denom,
+        "dtype": spec.dtype,
+        "shapes": [list(s) for s in spec.shapes],
+        "donate": list(spec.donate),
+        "hier": spec.hier is not None,
+        "digest": digest,
+        "mesh": _mesh_fingerprint(spec.mesh_key),
+    }
+
+
+def load_manifest(directory: str) -> List[dict]:
+    path = os.path.join(directory, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        entries = data.get("entries", [])
+        return entries if isinstance(entries, list) else []
+    except (OSError, ValueError):
+        return []
+
+
+def _record_manifest(spec: GroupSpec, digest: Optional[str]) -> None:
+    """Best-effort append of one cold build to the persistent-cache
+    manifest (dedup by structure, bounded, atomic rename; never takes
+    the executable lock — file IO must not nest inside it).  Only the
+    single-process group variants are recorded: the mp variant's mesh
+    and packed-buffer layout are incarnation-specific."""
+    d = compile_cache_dir()
+    if d is None or spec.variant not in ("sp_pr", "sp_rep"):
+        return
+    try:
+        entry = _manifest_entry(spec, digest)
+        entries = load_manifest(d)
+        key = {k: v for k, v in entry.items() if k != "digest"}
+        if any({k: v for k, v in e.items() if k != "digest"} == key
+               for e in entries):
+            return
+        entries.append(entry)
+        entries = entries[-MANIFEST_CAP:]
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, MANIFEST_NAME)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"format": "hvd-megakernel-manifest-v1",
+                       "entries": entries}, f, indent=1)
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 — the manifest is an optimization
+        pass
+
+
+def _warm_avals(spec: GroupSpec, mesh) -> List[jax.ShapeDtypeStruct]:
+    """Abstract inputs for AOT-lowering one recorded group executable
+    (global shapes + shardings exactly as launch() passes them)."""
+    n = len(spec.mesh_key)
+    dtype = jnp.dtype(spec.dtype)
+    if spec.variant == "sp_pr":
+        sh = NamedSharding(mesh, P(REPLICA_AXIS))
+        return [jax.ShapeDtypeStruct((n,) + shp, dtype, sharding=sh)
+                for shp in spec.shapes]
+    sh = NamedSharding(mesh, P())
+    return [jax.ShapeDtypeStruct(shp, dtype, sharding=sh)
+            for shp in spec.shapes]
+
+
+def warm_start(mesh, directory: Optional[str] = None) -> int:
+    """AOT-rebuild the manifest's group executables for ``mesh``.
+
+    Called by ``hvd.init()`` when ``HVD_TPU_COMPILE_CACHE_DIR`` is set:
+    every recorded group whose mesh fingerprint matches is re-traced and
+    compiled ahead of the first training step — against a warm XLA disk
+    cache the compile is a cache read, so an elastic relaunch resumes at
+    full step rate instead of paying the cold-compile stall mid-loop.
+    Hierarchy is recomputed from the CURRENT env/topology (the knobs may
+    legitimately differ across incarnations).  Best-effort per entry;
+    returns the number of executables warmed."""
+    d = directory or compile_cache_dir()
+    if d is None:
+        return 0
+    fp = _mesh_fingerprint(tuple(mesh.devices.flat))
+    mesh_key = tuple(mesh.devices.flat)
+    warmed = 0
+    t0 = time.perf_counter()
+    for entry in load_manifest(d):
+        if entry.get("mesh") != fp:
+            continue
+        if entry.get("variant") not in ("sp_pr", "sp_rep"):
+            continue
+        try:
+            spec = GroupSpec(
+                mesh_key=mesh_key, variant=entry["variant"],
+                op=entry["op"], average=bool(entry["average"]),
+                denom=int(entry["denom"]), dtype=entry["dtype"],
+                shapes=tuple(tuple(s) for s in entry["shapes"]),
+                donate=tuple(bool(x) for x in entry["donate"]),
+                hier=hierarchy_for(mesh_key, entry["op"], entry["dtype"]))
+            with _lock:
+                if spec in _compiled:
+                    continue
+            fn = _build(spec, mesh)
+            fn.lower(*_warm_avals(spec, mesh)).compile()
+            _cache_insert(spec, fn, entry.get("digest"))
+            warmed += 1
+        except Exception:  # noqa: BLE001 — a stale entry must not
+            continue       # break init; the group just compiles lazily
+    if warmed:
+        with _lock:
+            stats.warm_starts += warmed
+            stats.warm_seconds += time.perf_counter() - t0
+        print(f"[hvd-megakernel] warm start: {warmed} executables "
+              f"rebuilt from {os.path.join(d, MANIFEST_NAME)}",
+              file=sys.stderr)
+    return warmed
 
 
 def launch(spec: GroupSpec, mesh, values: Sequence,

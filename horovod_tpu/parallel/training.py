@@ -16,6 +16,9 @@ dryrun build on.
 
 from __future__ import annotations
 
+import collections
+import os
+import time
 from functools import partial
 from typing import Any, Callable, Optional, Tuple
 
@@ -24,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import telemetry as _telemetry
 from ..core import compat as _compat
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
@@ -33,6 +37,13 @@ try:
     import optax
 except Exception:  # pragma: no cover - optax is baked into the image
     optax = None
+
+# Shared with parallel/input.py and frontends/loop.py (same registry
+# entry): every place the loop blocks on the device/input feeds one
+# histogram, so "is training host-bound?" is a single metric.
+_M_HOST_STALL = _telemetry.histogram(
+    "host.stall_seconds", "seconds",
+    "time the training loop blocked waiting on the input queue")
 
 
 def batch_sharding(mesh=None) -> NamedSharding:
@@ -49,14 +60,22 @@ def replicated_sharding(mesh=None) -> NamedSharding:
 def shard_batch(batch, mesh=None):
     """Place a host batch onto the mesh, leading axis split across replicas
     (the per-rank data sharding the reference gets from DistributedSampler /
-    dataset shards, examples/pytorch_mnist.py:48-51)."""
-    sh = batch_sharding(mesh)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
+    dataset shards, examples/pytorch_mnist.py:48-51).
+
+    One batched ``jax.device_put`` over the whole pytree: a single
+    transfer program per batch instead of one dispatch per leaf (the
+    hvd-pipeline host-overlap contract; ``input.device_put_batch`` is
+    the one implementation, which :func:`.input.prefetch_to_device`
+    stages from a background thread)."""
+    from .input import device_put_batch
+
+    return device_put_batch(batch, mesh, sharding=batch_sharding(mesh))
 
 
 def replicate(tree, mesh=None):
-    sh = replicated_sharding(mesh)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+    from .input import device_put_batch
+
+    return device_put_batch(tree, mesh, sharding=replicated_sharding(mesh))
 
 
 def shard_local_batch(local_batch, mesh=None):
@@ -94,37 +113,62 @@ def _is_cpu_mesh(mesh) -> bool:
         return False
 
 
+def _max_inflight_cpu() -> int:
+    """In-flight step bound on CPU meshes (``HVD_TPU_MAX_INFLIGHT``,
+    default 2 = dispatch step N+1 while step N executes)."""
+    try:
+        return max(1, int(os.environ.get("HVD_TPU_MAX_INFLIGHT", "2")))
+    except ValueError:
+        return 2
+
+
 def _throttle_on_cpu(step_fn, mesh):
-    """Bound async dispatch to one in-flight invocation on CPU meshes.
+    """Bound async dispatch to a small in-flight window on CPU meshes.
 
     The host-platform backend (virtual devices for testing) runs every
     replica's collective on one shared thread pool; with unbounded async
     dispatch a long training loop stacks dozens of executions and the
     cross-replica rendezvous starves past XLA's 40 s abort
     (rendezvous.cc "Expected N threads to join").  Real TPU meshes are
-    untouched — their pipelining is the performance model.  Blocking on
-    the *previous* call's outputs keeps one step in flight, so even on
-    CPU the host never idles while a step runs.
+    untouched — their pipelining is the performance model.
+
+    The window defaults to 2 (``HVD_TPU_MAX_INFLIGHT``): calling the
+    step for N+1 blocks on step N-1's outputs, so one step is always
+    executing while the host dispatches the next — the pre-PR-5 hard
+    per-step barrier (block on N before dispatching N+1) put a dispatch
+    bubble between every pair of steps.  The blocked time is observed
+    as ``host.stall_seconds``.
     """
     if not _is_cpu_mesh(mesh):
         return step_fn
-    return _ThrottledStep(step_fn)
+    return _ThrottledStep(step_fn, _max_inflight_cpu())
 
 
 class _ThrottledStep:
-    """Callable wrapper keeping one invocation in flight (see
-    :func:`_throttle_on_cpu`); delegates the rest of the jit API
+    """Callable wrapper keeping at most ``depth`` invocations in flight
+    (see :func:`_throttle_on_cpu`); delegates the rest of the jit API
     (``lower``, ``trace``, ``clear_cache``, ...) to the wrapped step."""
 
-    def __init__(self, step_fn):
+    def __init__(self, step_fn, depth: int = 2):
         self._step_fn = step_fn
-        self._prev = None
+        self._depth = depth
+        self._inflight = collections.deque()
 
     def __call__(self, *args, **kw):
-        if self._prev is not None:
-            jax.block_until_ready(self._prev)
+        while len(self._inflight) >= self._depth:
+            popped = self._inflight.popleft()
+            t0 = time.perf_counter()
+            for leaf in jax.tree_util.tree_leaves(popped):
+                # A leaf donated into a later dispatch is deleted; that
+                # dispatch is ordered behind this one on every device,
+                # so blocking on the surviving leaves suffices.
+                deleted = getattr(leaf, "is_deleted", None)
+                if deleted is not None and deleted():
+                    continue
+                jax.block_until_ready(leaf)
+            _M_HOST_STALL.observe(time.perf_counter() - t0)
         out = self._step_fn(*args, **kw)
-        self._prev = out
+        self._inflight.append(out)
         return out
 
     def __getattr__(self, name):
@@ -288,13 +332,60 @@ def make_parallel_train_step(loss_fn: Callable[..., Any], optimizer,
 
 
 def shard_parallel_batch(batch, mesh, batch_spec):
-    """Place a host batch onto a multi-axis mesh per ``batch_spec``."""
-    def put(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
+    """Place a host batch onto a multi-axis mesh per ``batch_spec``
+    (a PartitionSpec, or a pytree of specs matching ``batch``) — one
+    batched ``jax.device_put`` over the whole pytree, preserving the
+    per-leaf shardings (same single-transfer contract as
+    :func:`shard_batch`)."""
+    from .input import device_put_batch
 
-    if isinstance(batch_spec, P):
-        return jax.tree_util.tree_map(lambda x: put(x, batch_spec), batch)
-    return jax.tree_util.tree_map(put, batch, batch_spec)
+    return device_put_batch(batch, mesh, sharding=batch_spec)
+
+
+# ---------------------------------------------------------------------------
+# Completion fencing for the async-dispatch loop
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, donate_argnums=())
+def _fence_program(x):
+    return x + 1
+
+
+def barrier_fence(*trees) -> None:
+    """Block the host until previously dispatched device work completes.
+
+    The async-dispatch loop (hvd-pipeline) returns un-fetched device
+    arrays and defers metric fetches, so the Python loop runs ahead of
+    the hardware.  Code that needs a completion point — wall-clock
+    measurement, checkpoint-consistent reads, handing buffers to
+    non-JAX code — calls this fence:
+
+    * ``barrier_fence(tree, ...)`` blocks until every leaf of the given
+      pytrees is computed (``jax.block_until_ready``).
+    * ``barrier_fence()`` blocks until EVERY local device of the replica
+      mesh has drained its execution stream: a trivial program is
+      dispatched per device behind all queued work and blocked on
+      (per-device programs execute in dispatch order).
+
+    Host-side only — no collective, no control-plane traffic (unlike
+    ``hvd.barrier()``, which synchronizes *ranks*).  The blocked time is
+    recorded in ``host.stall_seconds``.
+    """
+    t0 = time.perf_counter()
+    if trees:
+        for t in trees:
+            jax.block_until_ready(t)
+    else:
+        if _state.is_initialized():
+            devices = [d for d in _state.global_state().devices
+                       if d.process_index == jax.process_index()]
+        else:
+            devices = jax.local_devices()
+        probes = [_fence_program(jax.device_put(jnp.zeros((), jnp.int32), d))
+                  for d in devices]
+        for p in probes:
+            jax.block_until_ready(p)
+    _M_HOST_STALL.observe(time.perf_counter() - t0)
 
 
 def make_eval_step(metric_fn: Callable[..., Any], mesh=None):

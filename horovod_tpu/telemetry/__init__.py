@@ -138,6 +138,35 @@ def exception_event(where: str, text: str) -> None:
         _M_DUMPS.inc()
 
 
+# -- hvd-pipeline events (PR 5): input prefetch + checkpoint writer --------
+
+_M_PREFETCH_ERRORS = counter(
+    "input.prefetch_errors", "loader exceptions captured by prefetchers")
+_M_CKPT_ERRORS = counter(
+    "checkpoint.errors", "background checkpoint writes that failed")
+
+
+def prefetch_error_event(detail: str) -> None:
+    """A prefetch loader raised on the stager thread: count it and dump
+    the flight ring — the exception itself re-raises at the consuming
+    step (parallel/input.py), this is the forensic side channel."""
+    _M_PREFETCH_ERRORS.inc()
+    flight.record("prefetch_error", detail)
+    if flight.dump("prefetch-error", extra={"detail": detail}) is not None:
+        _M_DUMPS.inc()
+
+
+def checkpoint_error_event(path: str, detail: str) -> None:
+    """A background checkpoint write failed: the handle carries the
+    exception to ``wait()``; this records the failure even for callers
+    that never wait (fire-and-forget saves must not fail silently)."""
+    _M_CKPT_ERRORS.inc()
+    flight.record("checkpoint_error", path, detail)
+    if flight.dump("checkpoint-error",
+                   extra={"path": path, "detail": detail}) is not None:
+        _M_DUMPS.inc()
+
+
 def install_runtime_collector() -> None:
     """Register the pull-side collector over the runtime's existing
     cheap stats structs (CacheStats, MegakernelStats, the handle pool).
@@ -177,5 +206,6 @@ def install_runtime_collector() -> None:
         reg.gauge("megakernel.launches").set(ms.launches)
         reg.gauge("megakernel.hier_launches").set(ms.hier_launches)
         reg.gauge("megakernel.executables").set(_mk.cache_size())
+        reg.gauge("megakernel.warm_starts").set(ms.warm_starts)
 
     _default.register_collector("runtime", collect)

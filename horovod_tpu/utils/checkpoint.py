@@ -1,4 +1,5 @@
-"""Checkpoint/resume with the reference's rank-0 + broadcast conventions.
+"""Checkpoint/resume with the reference's rank-0 + broadcast conventions,
+overlapped with training (hvd-pipeline).
 
 The reference delegates serialization to the frameworks but fixes two
 conventions (SURVEY.md §5): save on rank 0 only (README.md:102-104,
@@ -8,43 +9,246 @@ then broadcast — including the scalar ``resume_from_epoch``
 
 Serialization uses flax msgpack (``flax.serialization``) — a single
 self-contained file, atomic-renamed into place.
+
+Background writes (PR 5)
+------------------------
+``save_checkpoint`` no longer blocks the training loop on disk: the
+caller pays only the device→host snapshot, then a dedicated rank-0
+writer thread serializes and publishes the file (tmp + ``os.replace``,
+so a reader NEVER sees a torn checkpoint — a write killed midway leaves
+the previous checkpoint intact and at most an orphaned ``*.tmp.*``).
+The returned :class:`CheckpointWrite` handle is truthy exactly when
+this process performs the save (the historical bool contract) and has
+``wait()`` for an explicit durability point; writes to one path apply
+in submission order (single FIFO writer).  ``restore_checkpoint`` and
+``resume_epoch`` fence pending writes to their path first, so
+read-after-write inside one process stays coherent.  Pending writes
+flush at interpreter exit (``atexit``); a writer failure re-raises at
+``wait()`` AND is flight-recorded (``checkpoint_error``) so
+fire-and-forget savers still see it.
+
+Telemetry (docs/metrics.md): ``checkpoint.write_seconds`` histogram
+(disk time per write, off the training loop), ``checkpoint.pending``
+gauge (queued+in-flight writes), ``checkpoint.errors`` counter.
 """
 
 from __future__ import annotations
 
+import atexit
 import os
+import queue
+import threading
+import time
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
+from .. import telemetry as _telemetry
+from ..analysis import lockorder as _lockorder
 from ..core import state as _state
 from ..parallel.data import broadcast_parameters
+
+_M_WRITE_SECONDS = _telemetry.histogram(
+    "checkpoint.write_seconds", "seconds",
+    "disk seconds per background checkpoint write")
+_M_PENDING = _telemetry.gauge(
+    "checkpoint.pending", "checkpoint writes queued or in flight")
+
+
+class CheckpointError(RuntimeError):
+    """A background checkpoint write failed (surfaced at ``wait()``)."""
+
+
+class CheckpointWrite:
+    """Handle for one (possibly still in-flight) checkpoint write.
+
+    Truthiness keeps the historical ``save_checkpoint`` bool contract:
+    truthy iff THIS process performs the save (rank 0), whether or not
+    the bytes hit disk yet.  ``wait()`` is the durability point."""
+
+    def __init__(self, path: Optional[str], performed: bool) -> None:
+        self.path = path
+        self._performed = performed
+        self._done = threading.Event()
+        self.error: Optional[BaseException] = None
+        if not performed:
+            self._done.set()  # nothing to wait for on non-saving ranks
+
+    def __bool__(self) -> bool:
+        return self._performed
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the write is durably published (atomic rename
+        complete).  Returns False on timeout; raises
+        :class:`CheckpointError` if the write failed."""
+        if not self._done.wait(timeout):
+            return False
+        if self.error is not None:
+            raise CheckpointError(
+                f"background checkpoint write to {self.path!r} failed: "
+                f"{type(self.error).__name__}: {self.error}"
+            ) from self.error
+        return True
+
+
+def _write_bytes(path: str, blob: bytes) -> None:
+    """Atomic publish: full write to a private tmp, then rename.  A
+    crash at ANY point leaves either the previous file or the new one —
+    never a torn read (tests kill this midway to prove it)."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+class _Writer:
+    """The rank-0 background checkpoint writer: one FIFO thread, so
+    writes to the same path apply in submission order."""
+
+    def __init__(self) -> None:
+        self._q: queue.Queue = queue.Queue()
+        self._pending = 0
+        self._lock = _lockorder.make_lock("checkpoint._Writer._lock")
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def submit(self, handle: CheckpointWrite, host_tree: Any,
+               step: Optional[int]) -> None:
+        with self._lock:
+            self._pending += 1
+            _M_PENDING.set(self._pending)
+        self._q.put((handle, host_tree, step))
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:  # drain sentinel (wait_all)
+                continue
+            handle, host_tree, step = item
+            t0 = time.perf_counter()
+            try:
+                from flax import serialization
+
+                blob = serialization.to_bytes(host_tree)
+                _write_bytes(handle.path, blob)
+                if step is not None:
+                    _write_bytes(f"{handle.path}.step",
+                                 str(step).encode())
+            except BaseException as e:  # noqa: BLE001 — carried to wait()
+                handle.error = e
+                _telemetry.checkpoint_error_event(
+                    handle.path, f"{type(e).__name__}: {e}")
+            finally:
+                _M_WRITE_SECONDS.observe(time.perf_counter() - t0)
+                with self._lock:
+                    self._pending -= 1
+                    _M_PENDING.set(self._pending)
+                handle._done.set()
+
+    def wait_all(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted write has finished (the atexit
+        flush; returns False on timeout)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.pending() > 0:
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
+
+_writer: Optional[_Writer] = None
+_writer_lock = _lockorder.make_lock("checkpoint._writer_lock")
+
+
+def _get_writer() -> _Writer:
+    global _writer
+    with _writer_lock:
+        if _writer is None or not _writer._thread.is_alive():
+            _writer = _Writer()
+            # Pending writes must survive a normal interpreter exit
+            # (the thread is a daemon — without this flush a short job
+            # could lose its final checkpoint).
+            atexit.register(_writer.wait_all, 30.0)
+        return _writer
+
+
+def pending_writes() -> int:
+    """Number of checkpoint writes queued or in flight on this process."""
+    with _writer_lock:
+        w = _writer
+    return w.pending() if w is not None else 0
+
+
+def wait_for_writes(timeout: Optional[float] = None) -> bool:
+    """Flush every pending background write (all paths)."""
+    with _writer_lock:
+        w = _writer
+    return w.wait_all(timeout) if w is not None else True
 
 
 def _is_saving_process() -> bool:
     return _state.process_index() == 0
 
 
-def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> bool:
-    """Save ``tree`` at ``path`` from the coordinating process only
-    (≙ the rank-0 guard in every reference example).  Returns True if this
-    process performed the save."""
-    if not _is_saving_process():
-        return False
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    from flax import serialization
+def _host_snapshot(tree: Any) -> Any:
+    """Device→host snapshot the writer thread can serialize later.
 
-    host_tree = jax.tree_util.tree_map(np.asarray, tree)
-    blob = serialization.to_bytes(host_tree)
-    tmp = f"{path}.tmp"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-    os.replace(tmp, path)  # atomic publish
-    if step is not None:
-        with open(f"{path}.step", "w") as f:
-            f.write(str(step))
-    return True
+    jax Arrays are immutable — ``np.asarray`` (the fetch) is safe to
+    alias.  Raw numpy leaves are COPIED: the caller may mutate them
+    in place after ``save_checkpoint`` returns, and the writer must
+    capture the value at call time (same rationale as
+    ``elastic._host_copy``)."""
+    def snap(x):
+        if isinstance(x, (int, float, bool, bytes, str)):
+            return x
+        if isinstance(x, np.ndarray):
+            return np.array(x)
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(snap, tree)
+
+
+def write_tree_async(path: str, host_tree: Any,
+                     step: Optional[int] = None) -> CheckpointWrite:
+    """Queue one already-host-resident tree for the background writer
+    (the low-level half of :func:`save_checkpoint`; ``elastic.commit``
+    feeds its snapshot through here so the commit barrier excludes disk
+    latency).  Caller must guarantee ``host_tree`` is not mutated
+    afterwards — :func:`_host_snapshot` produces such a tree."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    handle = CheckpointWrite(path, performed=True)
+    _get_writer().submit(handle, host_tree, step)
+    return handle
+
+
+def save_checkpoint(path: str, tree: Any, step: Optional[int] = None,
+                    block: bool = False) -> CheckpointWrite:
+    """Save ``tree`` at ``path`` from the coordinating process only
+    (≙ the rank-0 guard in every reference example).
+
+    The call returns after the device→host snapshot; serialization and
+    the atomic tmp+rename publish happen on the background writer
+    thread, overlapped with training.  Returns a
+    :class:`CheckpointWrite` — truthy iff this process performs the
+    save (the historical bool contract: ``if save_checkpoint(...)``),
+    with ``wait()`` as the explicit durability point.  ``block=True``
+    restores fully synchronous semantics."""
+    if not _is_saving_process():
+        return CheckpointWrite(path, performed=False)
+    handle = write_tree_async(path, _host_snapshot(tree), step=step)
+    if block:
+        handle.wait()
+    return handle
 
 
 def restore_checkpoint(path: str, target: Any, broadcast: bool = True) -> Any:
@@ -56,10 +260,13 @@ def restore_checkpoint(path: str, target: Any, broadcast: bool = True) -> Any:
     ``target`` and receive root's values through the broadcast, so a
     checkpoint that exists only on the coordinator's disk restores
     everywhere (the reference's save-on-rank-0 convention implies exactly
-    this asymmetry)."""
+    this asymmetry).  Pending background writes are fenced first, so a
+    restore right after an async save sees the new bytes (and the atomic
+    rename means it can never see torn ones)."""
     from flax import serialization
 
     if not _state.is_initialized() or _is_saving_process():
+        wait_for_writes()
         with open(path, "rb") as f:
             blob = f.read()
         tree = serialization.from_bytes(target, blob)
@@ -75,6 +282,8 @@ def resume_epoch(path: str) -> int:
     the reference broadcasts this scalar explicitly
     (keras_imagenet_resnet50.py:47-56)."""
     epoch = 0
+    if not _state.is_initialized() or _is_saving_process():
+        wait_for_writes()
     step_file = f"{path}.step"
     if os.path.exists(step_file):
         with open(step_file) as f:

@@ -101,6 +101,36 @@ def test_bench_dataplane_mode_contract_and_gates():
     assert tel["counters"].get("megakernel.launches", 0) >= 1, tel
 
 
+def test_bench_input_mode_contract_and_identity():
+    """`--mode input` (this round): the input-pipeline microbench emits
+    one contract JSON line — CPU-only like the other microbenches — and
+    must clear the DETERMINISTIC gate: bitwise-identical trained params
+    prefetch on vs off (overlap reorders host work, never arithmetic).
+    The ≥ 1.3x throughput gate lives in the CI `input-bench` job; here
+    only a loaded-box-safe floor is asserted (wall-clock ratios under a
+    concurrent tier-1 run are noise)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "input"],
+        env=dict(os.environ), cwd=REPO, capture_output=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    lines = [ln for ln in proc.stdout.decode().splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1, proc.stdout.decode()
+    payload = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "prefetch_on",
+                "prefetch_off", "speedup", "params_identical",
+                "loader_delay_ms"):
+        assert key in payload, payload
+    assert payload["metric"] == "input_pipeline_steps_per_sec"
+    assert payload["prefetch_on"] > 0 and payload["prefetch_off"] > 0
+    assert payload["params_identical"] is True, payload
+    # Host-overlap must not LOSE throughput even on a loaded box.
+    assert payload["speedup"] >= 0.9, payload
+    tel = payload["telemetry"]
+    assert tel["batches_staged"] and tel["batches_staged"] > 0
+
+
 @pytest.mark.slow
 def test_bench_failure_still_emits_contract_json():
     """A dead backend: the probe retries with backoff inside the budget

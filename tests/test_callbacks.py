@@ -186,7 +186,9 @@ def test_training_with_warmup_still_learns(hvd):
 def test_checkpoint_roundtrip_and_resume(hvd, tmp_path):
     params = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(3)}
     path = str(tmp_path / "ckpt.msgpack")
-    assert save_checkpoint(path, params, step=7) is True
+    write = save_checkpoint(path, params, step=7)
+    assert write  # truthy on the saving process (PR 5: a handle)
+    assert write.wait(10.0)
     target = {"w": jnp.zeros((2, 3)), "b": jnp.zeros(3)}
     restored = restore_checkpoint(path, target)
     np.testing.assert_allclose(np.asarray(restored["w"]),
@@ -219,7 +221,8 @@ def test_restore_checkpoint_before_init(tmp_path):
     path = str(tmp_path / "pre_init.msgpack")
     params = {"w": jnp.arange(4.0), "b": jnp.zeros(2)}
     hvd.init(devices=jax.devices())
-    assert save_checkpoint(path, params) is True
+    write = save_checkpoint(path, params)
+    assert write and write.wait(10.0)
     hvd.shutdown()
     assert not hvd.is_initialized()
     target = {"w": jnp.zeros(4), "b": jnp.ones(2)}

@@ -57,6 +57,9 @@ def test_disk_commit_and_fresh_incarnation_sync(hvd, tmp_path, monkeypatch):
     monkeypatch.setenv("HVD_TPU_ELASTIC_DIR", str(tmp_path))
     s = elastic.State(params={"w": jnp.full((3,), 2.5)}, epoch=6, batch=1)
     s.commit()
+    # PR 5: the disk publish is asynchronous; wait_committed() is
+    # the durability point.
+    assert s.wait_committed(10.0)
     assert (tmp_path / "elastic_state.msgpack").exists()
 
     fresh = elastic.State(params={"w": jnp.zeros((3,))}, epoch=0, batch=0)
