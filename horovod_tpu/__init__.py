@@ -87,7 +87,15 @@ from .core.features import (  # noqa: F401  (build/feature query shims)
 )
 from .ops.process_set import ProcessSet  # noqa: F401
 from .ops.wire import ReduceOp  # noqa: F401
-from .ops.compression import Compression  # noqa: F401
+from .ops.compression import (  # noqa: F401
+    Compression,
+    get_compression,
+    set_compression,
+)
+from .ops.megakernel import (  # noqa: F401
+    compression_state,
+    load_compression_state,
+)
 from .ops.objects import allgather_object, broadcast_object  # noqa: F401
 from .ops.sparse import IndexedSlices  # noqa: F401
 from .parallel.data import (  # noqa: F401

@@ -171,6 +171,18 @@ def init(devices=None) -> None:
         # Re-init with a different replica set: tear down the old runtime
         # (background thread, coordinator, timeline) first.
         shutdown()
+    # Validate the SPMD-program-selecting env knobs UP FRONT: a typo'd
+    # compressor / topology value must fail init with the full valid
+    # list, not surface as a trace error inside the first collective.
+    # (Cross-rank uniformity of the same knobs is checked by the
+    # control-plane HELLO handshake — ops/transport.py warns naming the
+    # rank and the divergent knobs.)
+    from ..ops import compression as _compression_env
+    from . import topology as _topology_env
+
+    _compression_env.validate_env()
+    _topology_env.validate_env()
+
     # Bootstrap the process cluster BEFORE the first device enumeration
     # (≙ MPI_Init_thread before MPI_Comm_rank, operations.cc:1173-1181).
     from . import cluster as _cluster

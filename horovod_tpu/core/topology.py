@@ -253,6 +253,23 @@ def hierarchical_mode() -> str:
     return mode
 
 
+def validate_env() -> None:
+    """Fail ``hvd.init()`` — not the first collective — on malformed
+    topology knobs.  These select the compiled SPMD program, so they
+    must also be UNIFORM across ranks; the control-plane handshake
+    cross-checks the combined fingerprint
+    (ops/compression.env_fingerprint)."""
+    hierarchical_mode()
+    value = os.environ.get(VIRTUAL_SLICES_ENV)
+    if value:
+        try:
+            int(value)
+        except ValueError:
+            raise ValueError(
+                f"{VIRTUAL_SLICES_ENV}={value!r}: expected an "
+                f"integer") from None
+
+
 @dataclass(frozen=True)
 class ReplicaHierarchy:
     """ICI x DCN decomposition of a flat replica axis of n devices.
@@ -267,6 +284,17 @@ class ReplicaHierarchy:
     ici_size: int
     ici_groups: Tuple[Tuple[int, ...], ...]
     dcn_groups: Tuple[Tuple[int, ...], ...]
+
+    def slice_of_positions(self) -> Tuple[int, ...]:
+        """Slice ordinal of every replica-axis position — the static
+        lookup table quantized hierarchical kernels index with
+        ``lax.axis_index`` to derive their per-leg noise/chunk
+        coordinates (ops/megakernel.py)."""
+        table = [0] * (self.n_slices * self.ici_size)
+        for si, group in enumerate(self.ici_groups):
+            for pos in group:
+                table[pos] = si
+        return tuple(table)
 
 
 def replica_hierarchy(devices: Sequence) -> Optional[ReplicaHierarchy]:

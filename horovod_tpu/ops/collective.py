@@ -55,6 +55,7 @@ from ..analysis import program as _program
 from ..core import compat as _compat
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
+from . import compression as _compression
 from . import megakernel as _megakernel
 from . import wire
 from .wire import ReduceOp, Request, RequestType, Response, ResponseType
@@ -763,10 +764,11 @@ def _divide(x, denom: int):
 # executable per fusion group instead of the per-tensor eager choreography
 # ---------------------------------------------------------------------------
 
-def _group_digest_fn(group: List["_QueuedOp"], psid: int):
+def _group_digest_fn(group: List["_QueuedOp"], psid: int, quant=None):
     """Lazy fusion-plan digest of one response group — the PR 2 cycle
     digest (ops/cache.cycle_digest scheme) the compiled executable is
-    recorded under; only evaluated on a cold compile."""
+    recorded under; only evaluated on a cold compile.  The quantization
+    spec is folded into the digest (ops/megakernel.plan_digest)."""
     def digest() -> str:
         entries = [_program.SignatureEntry(
             seq=0, op=o.op.name.lower(), name=o.name,
@@ -774,13 +776,43 @@ def _group_digest_fn(group: List["_QueuedOp"], psid: int):
             shape=tuple(o.contrib.shapes[0]),
             reduce_op=wire.reduce_op_name(o.red_op),
             process_set_id=psid) for o in group]
-        return _megakernel.plan_digest(entries)
+        return _megakernel.plan_digest(entries, quant)
     return digest
 
 
 def _megakernel_eligible(group: List["_QueuedOp"]) -> bool:
     return (_megakernel.enabled()
             and group[0].red_op != ReduceOp.ADASUM)
+
+
+def _tensor_wire_format(name: str, psid: int, red_op: ReduceOp, dtype,
+                        shape) -> Optional["_compression.WireFormat"]:
+    """The compression policy's wire format for ONE tensor, or None for
+    full precision.  Only the psum family quantizes (SUM/AVERAGE — the
+    gradient path); min/max/prod and Adasum always ride uncompressed."""
+    if _OP_KERNEL.get(red_op) != "psum":
+        return None
+    numel = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    return _compression.policy_format_for(name, psid, dtype, numel)
+
+
+def _partition_by_wire(group: List["_QueuedOp"], psid: int):
+    """Split one coordinator fusion group by per-tensor wire format
+    (the policy registry's selection surface: embeddings int8,
+    layernorm/scalars uncompressed, ...).  Deterministic across ranks:
+    keyed only on negotiated fields (name/dtype/shape/op) plus the
+    policy, which the env-uniformity contract pins fleet-wide.
+    Preserves first-appearance order."""
+    buckets: Dict[Any, List["_QueuedOp"]] = {}
+    order: List[Any] = []
+    for o in group:
+        fmt = _tensor_wire_format(o.name, psid, o.red_op,
+                                  o.contrib.dtype, o.contrib.shapes[0])
+        if fmt not in buckets:
+            buckets[fmt] = []
+            order.append(fmt)
+        buckets[fmt].append(o)
+    return [(fmt, buckets[fmt]) for fmt in order]
 
 
 def _tl_group_start(tl, group: List["_QueuedOp"]) -> None:
@@ -799,30 +831,73 @@ def _tl_group_end(tl, group: List["_QueuedOp"], hier) -> None:
         tl.end(o.name, dtype=str(o.contrib.dtype))
 
 
+def _quant_group_key(variant: str, psid: int, names: Sequence[str],
+                     fmt) -> tuple:
+    """The ONE tick/noise-stream key scheme for every executor path
+    (fused sp/mp and the eager reference fallback) — the bitwise
+    fused≡eager contract depends on all of them counting steps under
+    the same key.  Flat tuple of scalars only: it round-trips through
+    JSON in compression_state() (a nested tuple would come back as an
+    unhashable list)."""
+    return (variant, psid, fmt.name if fmt is not None else "") \
+        + tuple(names)
+
+
 def _launch_group_megakernel(group: List["_QueuedOp"], layout: bool,
-                             denom: int, ps, mesh, tl, hm) -> bool:
+                             denom: int, ps, mesh, tl, hm,
+                             fmt=None) -> bool:
     """Single-process fused-group launch: ONE jitted donated executable
     packs the group, reduces once (hierarchically on multi-slice
-    meshes), folds the AVERAGE divide and unpacks — exactly one XLA
-    dispatch per fusion group.  Returns False to fall back to the
-    per-tensor eager path (unbuildable spec)."""
+    meshes, quantized when the compression policy says so), folds the
+    AVERAGE divide and unpacks — exactly one XLA dispatch per fusion
+    group.  Returns False to fall back to the per-tensor eager path
+    (unbuildable spec)."""
     o0 = group[0]
     op_kernel = _OP_KERNEL[o0.red_op]
     mesh_key = tuple(mesh.devices.flat)
+    variant = "sp_pr" if layout else "sp_rep"
+    psid = 0 if ps is None else ps.process_set_id
     spec = _megakernel.GroupSpec(
-        mesh_key=mesh_key, variant="sp_pr" if layout else "sp_rep",
+        mesh_key=mesh_key, variant=variant,
         op=op_kernel, average=o0.red_op == ReduceOp.AVERAGE, denom=denom,
         dtype=jnp.dtype(o0.contrib.dtype).name,
         shapes=tuple(tuple(o.contrib.shapes[0]) for o in group),
         donate=tuple(bool(o.contrib.owned) for o in group),
         hier=_megakernel.hierarchy_for(mesh_key, op_kernel,
-                                       o0.contrib.dtype))
+                                       o0.contrib.dtype, group_fmt=fmt),
+        quant=fmt)
     values = [o.contrib.value for o in group]
-    psid = 0 if ps is None else ps.process_set_id
+    donate_mask = list(spec.donate)
+    res_keys: List[tuple] = []
+    if _megakernel._needs_quant_build(spec):
+        use_ef = (fmt is not None and fmt.kind == "quant"
+                  and fmt.error_feedback and spec.hier is None)
+        if use_ef:
+            # Error-feedback residual: executor-owned flat group buffer
+            # fed back in (and donated) each step, replaced by the
+            # kernel's residual output after the launch.  take_
+            # semantics: once donated, the store must not reference it.
+            res_keys = [("g", psid) + tuple(o.name for o in group)]
+            T = sum(int(np.prod(s, dtype=np.int64)) if s else 1
+                    for s in spec.shapes)
+            res_shape = (len(mesh_key), T) if layout else (T,)
+            stored = _megakernel.take_residual(
+                res_keys[0], o0.contrib.dtype, [res_shape])
+            values.append(stored if stored is not None
+                          else np.zeros(res_shape,
+                                        jnp.dtype(o0.contrib.dtype)))
+            donate_mask.append(True)
+        tick = _megakernel.next_tick(_quant_group_key(
+            variant, psid, [o.name for o in group], fmt))
+        values.append(np.asarray(
+            [_compression.quant_seed(), tick], np.uint32))
+        donate_mask.append(False)
     if tl: _tl_group_start(tl, group)
     try:
-        outs = _megakernel.launch(spec, mesh, values,
-                                  digest_fn=_group_digest_fn(group, psid))
+        outs = _megakernel.launch(
+            spec, mesh, values,
+            digest_fn=_group_digest_fn(group, psid, fmt),
+            donate_mask=donate_mask)
     except Exception as e:  # noqa: BLE001 — unbuildable spec
         import traceback
 
@@ -831,8 +906,14 @@ def _launch_group_megakernel(group: List["_QueuedOp"], layout: bool,
             for o in group:
                 tl.activity_end(o.name)
                 tl.end(o.name, dtype=str(o.contrib.dtype))
-        if not any(d and isinstance(v, jax.Array) and v.is_deleted()
-                   for v, d in zip(values, spec.donate)):
+        consumed = any(d and isinstance(v, jax.Array) and v.is_deleted()
+                       for v, d in zip(values, donate_mask))
+        if res_keys and consumed:
+            # The stored residual buffers were donated into a launch
+            # that died: they reference deleted memory — restart them
+            # from zero rather than poison the next launch.
+            _megakernel.drop_residuals(res_keys)
+        if not consumed:
             return False  # inputs intact: per-tensor eager fallback
         # A RUNTIME failure after XLA already consumed the donated
         # inputs (trace/compile errors leave them intact): an eager
@@ -845,6 +926,9 @@ def _launch_group_megakernel(group: List["_QueuedOp"], layout: bool,
         for o in group:
             hm._get(o.handle).result = err
         return True
+    if res_keys:
+        _megakernel.store_residuals(res_keys, [outs[-1]])
+        outs = outs[:len(group)]
     for o, out in zip(group, outs):
         # Donated (or simply consumed) input: nothing may read it after
         # dispatch — drop the reference so use-after-donate is
@@ -856,46 +940,141 @@ def _launch_group_megakernel(group: List["_QueuedOp"], layout: bool,
     return True
 
 
+def _eager_quantized_group(group: List["_QueuedOp"], layout: bool,
+                           denom: int, ps, mesh, tl, hm, fmt) -> None:
+    """Per-tensor-executor fallback for a quantized group
+    (HVD_TPU_MEGAKERNEL=0, or an unbuildable fused spec): the
+    eager-quantized REFERENCE math (ops/compression.reference_allreduce
+    — the function the megakernel is tested bitwise against), driven by
+    the same residual store and tick counter as the fused path.  Always
+    the flat two-phase formulation — the hierarchical per-leg pipeline
+    exists only inside the fused executable."""
+    n = len(tuple(mesh.devices.flat))
+    psid = 0 if ps is None else ps.process_set_id
+    variant = "sp_pr" if layout else "sp_rep"
+    dtype = jnp.dtype(group[0].contrib.dtype)
+    use_ef = fmt.error_feedback
+    res_key = ("g", psid) + tuple(o.name for o in group)
+    if tl: _tl_group_start(tl, group)
+    if layout:
+        rows = jnp.concatenate(
+            [jnp.asarray(o.contrib.value).reshape(n, -1) for o in group],
+            axis=1)
+    else:
+        flat = jnp.concatenate(
+            [jnp.ravel(jnp.asarray(o.contrib.value)) for o in group])
+        rows = jnp.broadcast_to(flat[None], (n, flat.shape[0]))
+    T = rows.shape[1]
+    residuals = None
+    if use_ef:
+        res_shape = (n, T) if layout else (T,)
+        stored = _megakernel.take_residual(res_key, dtype, [res_shape])
+        residuals = jnp.asarray(
+            stored if stored is not None
+            else np.zeros(res_shape, dtype))
+        if not layout:
+            residuals = jnp.broadcast_to(residuals[None], (n, T))
+    tick = _megakernel.next_tick(_quant_group_key(
+        variant, psid, [o.name for o in group], fmt))
+    red, r_new = _compression.reference_allreduce(
+        rows, fmt, tick, residuals=residuals, shared_noise=not layout)
+    if r_new is not None:
+        _megakernel.store_residuals(
+            [res_key], [r_new if layout else r_new[0]])
+    offs = 0
+    for o in group:
+        cnt = int(np.prod(o.contrib.shapes[0], dtype=np.int64)) \
+            if o.contrib.shapes[0] else 1
+        shape = tuple(o.contrib.shapes[0])
+        piece = red[offs:offs + cnt].reshape(shape)
+        if o.red_op == ReduceOp.AVERAGE:
+            piece = _divide(piece, denom)
+        if layout:
+            piece = jnp.broadcast_to(piece[None], (n,) + shape)
+        offs += cnt
+        o.contrib.value = None
+        hm._get(o.handle).result = piece
+    if tl: _tl_group_end(tl, group, None)
+
+
 def _launch_mp_megakernel(resp: Response, ops: List["_QueuedOp"], ps,
                           mesh, denom: int, tl, hm) -> bool:
-    """Multi-process fused-group launch: one jitted local pack (donating
-    executor-owned contributions) → one donated reduce+divide+unpack
-    executable over the process mesh.  Handles the joined-rank case
-    transparently: ``resp`` names tensors this rank never submitted —
-    they contribute zeros and their outputs are discarded, exactly like
-    the peers' buffer."""
-    st = _state.global_state()
+    """Multi-process fused launch of one coordinator response,
+    sub-partitioned by the compression policy's per-tensor wire format
+    (the partition is a pure function of negotiated fields + the
+    rank-uniform policy, so every process splits the response
+    identically).  A bucket whose fused spec is unbuildable falls back
+    to the per-bucket eager path — deterministically on every rank.
+    Returns True once the whole response is handled."""
     by_name = {o.name: o for o in ops}
     dtype = (jnp.dtype(ops[0].contrib.dtype) if ops
              else jnp.dtype(wire.np_dtype_of(resp.tensor_type)))
+    red_op = ops[0].red_op if ops else resp.reduce_op
+    psid = 0 if ps is None else ps.process_set_id
     shapes = []
-    values = []
-    donate = []
     for pos, name in enumerate(resp.tensor_names):
         o = by_name.get(name)
         if o is not None:
             shapes.append(tuple(o.contrib.shapes[0]))
+        else:
+            shapes.append(tuple(resp.tensor_shapes[pos])
+                          if pos < len(resp.tensor_shapes)
+                          else tuple(resp.tensor_shapes[0]))
+    buckets: Dict[Any, List[int]] = {}
+    order: List[Any] = []
+    for pos, name in enumerate(resp.tensor_names):
+        fmt = _tensor_wire_format(name, psid, red_op, dtype, shapes[pos])
+        if fmt not in buckets:
+            buckets[fmt] = []
+            order.append(fmt)
+        buckets[fmt].append(pos)
+    for fmt in order:
+        idxs = buckets[fmt]
+        names_sub = [resp.tensor_names[i] for i in idxs]
+        shapes_sub = [shapes[i] for i in idxs]
+        if not _launch_mp_megakernel_sub(
+                names_sub, shapes_sub, by_name, ps, mesh, denom, tl, hm,
+                fmt, red_op, dtype, psid):
+            _eager_mp_subset(names_sub, shapes_sub, by_name, ps, denom,
+                             red_op, dtype, tl, hm)
+    return True
+
+
+def _launch_mp_megakernel_sub(names: List[str], shapes: List[tuple],
+                              by_name: Dict[str, "_QueuedOp"], ps, mesh,
+                              denom: int, tl, hm, fmt, red_op, dtype,
+                              psid: int) -> bool:
+    """One wire-format bucket of a multi-process response: one jitted
+    local pack (donating executor-owned contributions) → one donated
+    reduce+divide+unpack executable over the process mesh — quantized
+    in-kernel when ``fmt`` says so.  Handles the joined-rank case
+    transparently: ``names`` may include tensors this rank never
+    submitted — they contribute zeros and their outputs are discarded,
+    exactly like the peers' buffer."""
+    values = []
+    donate = []
+    for name, shp in zip(names, shapes):
+        o = by_name.get(name)
+        if o is not None:
             values.append(o.contrib.value)
             donate.append(bool(o.contrib.owned))
         else:
-            shp = (tuple(resp.tensor_shapes[pos])
-                   if pos < len(resp.tensor_shapes)
-                   else tuple(resp.tensor_shapes[0]))
-            shapes.append(shp)
             values.append(jnp.zeros(shp, dtype))  # joined: zero slot
             donate.append(True)
-    avg = ((ops[0].red_op if ops else resp.reduce_op)
-           == ReduceOp.AVERAGE)
-    op_kernel = _OP_KERNEL[ops[0].red_op if ops else resp.reduce_op]
+    avg = red_op == ReduceOp.AVERAGE
+    op_kernel = _OP_KERNEL[red_op]
     mesh_key = tuple(mesh.devices.flat)
     spec = _megakernel.GroupSpec(
         mesh_key=mesh_key, variant="mp", op=op_kernel, average=avg,
         denom=denom, dtype=dtype.name, shapes=tuple(shapes),
         donate=(True,),  # the packed buffer is always executor-owned
-        hier=_megakernel.hierarchy_for(mesh_key, op_kernel, dtype))
-    group = [by_name[n] for n in resp.tensor_names if n in by_name]
+        hier=_megakernel.hierarchy_for(mesh_key, op_kernel, dtype,
+                                       group_fmt=fmt),
+        quant=fmt)
+    group = [by_name[n] for n in names if n in by_name]
     if tl: _tl_group_start(tl, group)
     consumed = False
+    res_key = None
     try:
         pack = _megakernel.packer(tuple(shapes), dtype.name,
                                   tuple(donate), mesh_key)
@@ -907,10 +1086,41 @@ def _launch_mp_megakernel(resp: Response, ops: List["_QueuedOp"], ps,
         consumed = any(d and isinstance(v, jax.Array) and v.is_deleted()
                        for v, d in zip(values, donate))
         buf = _mp_global(flat, ps)
-        psid = 0 if ps is None else ps.process_set_id
-        outs = _megakernel.launch(spec, mesh, [buf],
-                                  digest_fn=_group_digest_fn(group, psid)
-                                  if group else None)
+        launch_values = [buf]
+        donate_mask = [True]
+        if _megakernel._needs_quant_build(spec):
+            use_ef = (fmt is not None and fmt.kind == "quant"
+                      and fmt.error_feedback and spec.hier is None)
+            if use_ef:
+                T = sum(int(np.prod(s, dtype=np.int64)) if s else 1
+                        for s in shapes)
+                Pn = len(mesh_key)
+                res_key = ("g", psid) + tuple(names)
+                # The live residual is the previous launch's [P, T]
+                # global OUTPUT, reused on-device (no per-step
+                # device→host→device round trip); a checkpoint-restored
+                # local [T] numpy shard re-uploads once.
+                stored = _megakernel.take_residual(
+                    res_key, dtype, [(Pn, T), (T,)])
+                if isinstance(stored, jax.Array) \
+                        and stored.shape == (Pn, T):
+                    res_buf = stored
+                elif stored is not None:
+                    res_buf = _mp_global(jnp.asarray(stored), ps)
+                else:
+                    res_buf = _mp_global(jnp.zeros((T,), dtype), ps)
+                launch_values.append(res_buf)
+                donate_mask.append(True)
+            tick = _megakernel.next_tick(
+                _quant_group_key("mp", psid, names, fmt))
+            launch_values.append(np.asarray(
+                [_compression.quant_seed(), tick], np.uint32))
+            donate_mask.append(False)
+        outs = _megakernel.launch(
+            spec, mesh, launch_values,
+            digest_fn=_group_digest_fn(group, psid, fmt)
+            if group else None,
+            donate_mask=donate_mask)
     except Exception as e:  # noqa: BLE001 — unbuildable spec
         import traceback
 
@@ -919,6 +1129,8 @@ def _launch_mp_megakernel(resp: Response, ops: List["_QueuedOp"], ps,
             for o in group:
                 tl.activity_end(o.name)
                 tl.end(o.name, dtype=str(o.contrib.dtype))
+        if res_key is not None:
+            _megakernel.drop_residuals([res_key])
         if not consumed:
             return False  # inputs intact: per-tensor eager fallback
         # The pack already donated the executor-owned inputs; an eager
@@ -931,13 +1143,61 @@ def _launch_mp_megakernel(resp: Response, ops: List["_QueuedOp"], ps,
         for o in group:
             hm._get(o.handle).result = err
         return True
-    for name, out in zip(resp.tensor_names, outs):
+    if res_key is not None:
+        # Store the residual output — a P(hvd)-sharded [P, T] global —
+        # AS the device array: the next launch donates it straight back
+        # in (compression_state() exports the addressable shard when a
+        # snapshot is taken).
+        _megakernel.store_residuals([res_key], [outs[-1]])
+        outs = outs[:-1]
+    for name, out in zip(names, outs):
         o = by_name.get(name)
         if o is not None:
             o.contrib.value = None  # consumed: see _launch_group_megakernel
             hm._get(o.handle).result = out
     if tl: _tl_group_end(tl, group, spec.hier)
     return True
+
+
+def _eager_mp_subset(names: List[str], shapes: List[tuple],
+                     by_name: Dict[str, "_QueuedOp"], ps, denom: int,
+                     red_op, dtype, tl, hm) -> None:
+    """Eager (uncompressed) execution of one wire-format bucket of a
+    multi-process response — the deterministic per-bucket fallback when
+    its fused spec is unbuildable.  A quantized bucket landing here
+    loses its compression for the step, never its correctness (every
+    rank takes the same branch, so the SPMD programs still match)."""
+    _, ks = (_mp_kernels() if ps is None else ps.mesh_and_kernels())
+    group = [by_name[n] for n in names if n in by_name]
+    for o in group:
+        if tl: _tl_start(tl, o, "ALLREDUCE")
+        if tl: tl.activity_start(o.name, "MEMCPY_IN_FUSION_BUFFER")
+
+    def numel(s):
+        return int(np.prod(s, dtype=np.int64)) if s else 1
+
+    parts = [jnp.ravel(by_name[n].contrib.value) if n in by_name
+             else jnp.zeros((numel(s),), dtype)
+             for n, s in zip(names, shapes)]
+    buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+    for o in group:
+        if tl: tl.activity_end(o.name)
+        if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
+    red = ks[_OP_KERNEL[red_op] + "_out_rep"](_mp_global(buf, ps))
+    offs = 0
+    for n, s in zip(names, shapes):
+        o = by_name.get(n)
+        cnt = numel(s)
+        if o is not None:
+            if tl: tl.activity_end(o.name)
+            if tl: tl.activity_start(o.name, "MEMCPY_OUT_FUSION_BUFFER")
+            piece = red[offs:offs + cnt].reshape(s)
+            if o.red_op == ReduceOp.AVERAGE:
+                piece = _divide(piece, denom)
+            if tl: tl.activity_end(o.name)
+            if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
+            hm._get(o.handle).result = piece
+        offs += cnt
 
 
 # ---------------------------------------------------------------------------
@@ -1197,69 +1457,98 @@ def _execute_response_inner(resp: Response, ops: List[_QueuedOp]) -> None:
         # Sub-group by layout: per-replica vs replicated inputs reduce with
         # different shardings and cannot share one flat buffer.  The group
         # is homogeneous in red_op (the coordinator fuses like-op only).
+        psid = 0 if ps is None else ps.process_set_id
         for layout in (True, False):
-            group = [o for o in ops if o.contrib.per_replica == layout]
-            if not group:
+            lgroup = [o for o in ops if o.contrib.per_replica == layout]
+            if not lgroup:
                 continue
-            # Megakernel path (default): one donated pack→reduce→unpack
-            # executable per fusion group — a single XLA dispatch, with
-            # the AVERAGE divide folded in and a hierarchical ICI×DCN
-            # reduction on multi-slice meshes (ops/megakernel.py).
-            if _megakernel_eligible(group) and _launch_group_megakernel(
-                    group, layout, denom, ps, mesh, tl, hm):
-                continue
-            # Eager fallback (HVD_TPU_MEGAKERNEL=0): the per-tensor
-            # choreography — also the bench's comparison baseline.
-            avg = group[0].red_op == ReduceOp.AVERAGE
-            kernel = ks[_OP_KERNEL[group[0].red_op]
-                        + ("_pr" if layout else "_rep")]
-            if len(group) == 1:
-                o = group[0]
-                if tl: _tl_start(tl, o, "ALLREDUCE")
-                if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
-                if avg:
-                    # Single-tensor AVERAGE: divide folded into the
-                    # compiled kernel, not a separate eager dispatch.
-                    out = ks["psum_pr_avg" if layout
-                             else "psum_rep_avg"](o.contrib.value)
-                else:
-                    out = kernel(o.contrib.value)
-                if tl: tl.activity_end(o.name)
-                if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
-                hm._get(o.handle).result = out
-                continue
-            # Fused path.
-            for o in group:
-                if tl: _tl_start(tl, o, "ALLREDUCE")
-                if tl: tl.activity_start(o.name, "MEMCPY_IN_FUSION_BUFFER")
-            if layout:
-                # per-replica: flatten payload per replica, concat axis 1.
-                parts = [o.contrib.value.reshape(st.size, -1) for o in group]
-                buf = jnp.concatenate(parts, axis=1)
-            else:
-                buf = jnp.concatenate(
-                    [jnp.ravel(o.contrib.value) for o in group])
-            for o in group:
-                if tl: tl.activity_end(o.name)
-                if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
-            red = kernel(buf)
-            offs = 0
-            for o in group:
-                n = int(np.prod(o.contrib.shapes[0], dtype=np.int64)) if \
-                    o.contrib.shapes[0] else 1
-                if tl: tl.activity_end(o.name)
-                if tl: tl.activity_start(o.name, "MEMCPY_OUT_FUSION_BUFFER")
+            # Sub-partition by the compression policy's per-tensor wire
+            # format (embeddings int8, layernorm/scalars uncompressed,
+            # ...): tensors with different codecs cannot share one
+            # fused executable.  With the default policy (none) this is
+            # a single bucket — the pre-quantization behavior.
+            for fmt, group in _partition_by_wire(lgroup, psid):
+                # Megakernel path (default): one donated
+                # pack→reduce→unpack executable per fusion group — a
+                # single XLA dispatch, with the AVERAGE divide (and the
+                # quantize/dequantize pipeline) folded in and a
+                # hierarchical ICI×DCN reduction on multi-slice meshes
+                # (ops/megakernel.py).
+                if _megakernel_eligible(group) \
+                        and _launch_group_megakernel(
+                            group, layout, denom, ps, mesh, tl, hm, fmt):
+                    continue
+                if fmt is not None and fmt.kind == "quant":
+                    # Eager fallback keeps the quantized semantics via
+                    # the reference math (same residuals, same ticks).
+                    _eager_quantized_group(group, layout, denom, ps,
+                                           mesh, tl, hm, fmt)
+                    continue
+                # Eager fallback (HVD_TPU_MEGAKERNEL=0): the per-tensor
+                # choreography — also the bench's comparison baseline.
+                avg = group[0].red_op == ReduceOp.AVERAGE
+                kernel = ks[_OP_KERNEL[group[0].red_op]
+                            + ("_pr" if layout else "_rep")]
+                wire_dt = jnp.dtype(fmt.wire_dtype) if fmt is not None \
+                    else None
+                if len(group) == 1 and fmt is None:
+                    o = group[0]
+                    if tl: _tl_start(tl, o, "ALLREDUCE")
+                    if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
+                    if avg:
+                        # Single-tensor AVERAGE: divide folded into the
+                        # compiled kernel, not a separate eager dispatch.
+                        out = ks["psum_pr_avg" if layout
+                                 else "psum_rep_avg"](o.contrib.value)
+                    else:
+                        out = kernel(o.contrib.value)
+                    if tl: tl.activity_end(o.name)
+                    if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
+                    hm._get(o.handle).result = out
+                    continue
+                # Fused path (also the cast-wire path: compress the
+                # flat buffer, reduce in the wire dtype, decompress
+                # BEFORE the divide — the compression.py order).
+                for o in group:
+                    if tl: _tl_start(tl, o, "ALLREDUCE")
+                    if tl: tl.activity_start(o.name,
+                                             "MEMCPY_IN_FUSION_BUFFER")
                 if layout:
-                    piece = red[:, offs:offs + n].reshape(
-                        (st.size,) + tuple(o.contrib.shapes[0]))
+                    # per-replica: flatten payload per replica, concat
+                    # axis 1.
+                    parts = [o.contrib.value.reshape(st.size, -1)
+                             for o in group]
+                    buf = jnp.concatenate(parts, axis=1)
                 else:
-                    piece = red[offs:offs + n].reshape(o.contrib.shapes[0])
-                offs += n
-                if o.red_op == ReduceOp.AVERAGE:
-                    piece = _divide(piece, denom)
-                if tl: tl.activity_end(o.name)
-                if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
-                hm._get(o.handle).result = piece
+                    buf = jnp.concatenate(
+                        [jnp.ravel(o.contrib.value) for o in group])
+                for o in group:
+                    if tl: tl.activity_end(o.name)
+                    if tl: tl.activity_start(o.name, "XLA_ALLREDUCE")
+                if wire_dt is not None:
+                    red = kernel(buf.astype(wire_dt)).astype(buf.dtype)
+                else:
+                    red = kernel(buf)
+                offs = 0
+                for o in group:
+                    n = int(np.prod(o.contrib.shapes[0],
+                                    dtype=np.int64)) if \
+                        o.contrib.shapes[0] else 1
+                    if tl: tl.activity_end(o.name)
+                    if tl: tl.activity_start(o.name,
+                                             "MEMCPY_OUT_FUSION_BUFFER")
+                    if layout:
+                        piece = red[:, offs:offs + n].reshape(
+                            (st.size,) + tuple(o.contrib.shapes[0]))
+                    else:
+                        piece = red[offs:offs + n].reshape(
+                            o.contrib.shapes[0])
+                    offs += n
+                    if o.red_op == ReduceOp.AVERAGE:
+                        piece = _divide(piece, denom)
+                    if tl: tl.activity_end(o.name)
+                    if tl: tl.end(o.name, dtype=str(o.contrib.dtype))
+                    hm._get(o.handle).result = piece
         return
 
     if resp.response_type == ResponseType.ALLTOALL:

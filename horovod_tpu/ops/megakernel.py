@@ -34,9 +34,22 @@ invalidation hook as the memoized fusion plans
 On multi-slice DCN deployments (``core/topology.replica_hierarchy``)
 the ALLREDUCE reduction is lowered hierarchically — ``psum_scatter``
 over ICI → ``psum`` over DCN → ``all_gather`` over ICI — which moves
-``1/ici_size`` of the bytes over the slow DCN leg, optionally narrowed
-to bf16/fp16 on that leg only (``HVD_TPU_DCN_COMPRESS``, reusing
-ops/compression.py; cf. EQuARX, arXiv:2506.17615).
+``1/ici_size`` of the bytes over the slow DCN leg, with each leg's wire
+format composing independently (``HVD_TPU_DCN_COMPRESS`` /
+``HVD_TPU_ICI_COMPRESS``: full precision, bf16/fp16 casts, or int8/int4
+quantized exchanges; cf. EQuARX, arXiv:2506.17615).
+
+Quantized reduction (this PR's tentpole): when the compression policy
+(ops/compression.py, ``hvd.set_compression`` / ``HVD_TPU_COMPRESSION``)
+selects int8/int4 for a fusion group, the pack→reduce→unpack executable
+compiles the block-scaled quantize → wire exchange → dequantize
+pipeline INTO the same single XLA program — zero extra dispatches —
+with stochastic rounding (seeded per step via the ``st`` input, so the
+executable is reused across steps) and **error-feedback residuals**:
+per-tensor state owned by this executor, added to the next step's
+contribution inside the kernel, flushed with the executable cache on
+plan invalidation, and checkpoint-restorable
+(:func:`compression_state` / :func:`load_compression_state`).
 
 Env contract (docs/performance.md):
   HVD_TPU_MEGAKERNEL=0           fall back to the per-tensor eager
@@ -44,7 +57,13 @@ Env contract (docs/performance.md):
                                  comparison baseline)
   HVD_TPU_HIERARCHICAL=auto|on|off   see core/topology.py
   HVD_TPU_VIRTUAL_SLICES=<k>         see core/topology.py
-  HVD_TPU_DCN_COMPRESS=none|bf16|fp16  DCN-leg wire dtype (default none)
+  HVD_TPU_DCN_COMPRESS=none|bf16|fp16|int8|int4
+                                 DCN-leg wire format (default: inherit
+                                 the group's quantized format, else
+                                 full precision)
+  HVD_TPU_ICI_COMPRESS=none|int8|int4  ICI-leg wire format (default
+                                 none = full precision)
+  HVD_TPU_COMPRESSION / HVD_TPU_QUANT_*  see ops/compression.py
 """
 
 from __future__ import annotations
@@ -70,8 +89,17 @@ from ..core import compat as _compat
 from ..core import topology as _topology
 from ..core.state import REPLICA_AXIS
 from ..utils import xla_dispatch as _xla_dispatch
+from .. import telemetry as _telemetry
 from . import compression as _compression
 from .wire import ReduceOp
+
+# hvd-telemetry (docs/metrics.md): per-launch bytes the fused
+# collective moves in WIRE format — the quantized-allreduce observable
+# (the matching logical bytes ride MegakernelStats and surface as the
+# compression.ratio gauge).
+_M_WIRE_BYTES = _telemetry.histogram(
+    "collective.wire_bytes", "bytes",
+    "wire-format bytes per fused collective launch")
 
 # Compiled-executable cache bound: a stable program needs one entry per
 # (fusion group structure x mesh); jittery tick partitioning can mint a
@@ -80,6 +108,7 @@ from .wire import ReduceOp
 CACHE_CAPACITY = 128
 
 DCN_COMPRESS_ENV = "HVD_TPU_DCN_COMPRESS"
+ICI_COMPRESS_ENV = "HVD_TPU_ICI_COMPRESS"
 
 # Persistent compile cache (hvd-pipeline): when set, (a) jax's XLA
 # compilation cache persists to this directory (wired by core/state.init)
@@ -119,11 +148,15 @@ _OPS = ("psum", "pmin", "pmax", "pprod")
 @dataclass(frozen=True)
 class Hierarchy:
     """Static hierarchical-reduction parameters baked into a kernel:
-    the topology's ICI×DCN decomposition plus the DCN-leg wire dtype
-    (None = uncompressed)."""
+    the topology's ICI×DCN decomposition plus each leg's wire format —
+    ``wire_dtype`` is the DCN cast narrowing (bf16/fp16), ``dcn_quant``
+    / ``ici_quant`` the quantized exchange formats (ops/compression.py
+    WireFormat); None everywhere = full precision."""
 
     topo: _topology.ReplicaHierarchy
     wire_dtype: Optional[str]
+    dcn_quant: Optional[_compression.WireFormat] = None
+    ici_quant: Optional[_compression.WireFormat] = None
 
 
 @dataclass(frozen=True)
@@ -131,7 +164,10 @@ class GroupSpec:
     """Cache key of one fused-group executable: everything that changes
     the traced program.  ``mesh_key`` is the tuple of jax Device
     OBJECTS (the same convention as ops/collective._kernels: a
-    restarted backend's fresh devices miss naturally)."""
+    restarted backend's fresh devices miss naturally).  ``quant`` is
+    the group's wire format from the compression policy (None = full
+    precision; "cast" folds dtype narrowing around the reduction;
+    "quant" compiles the int8/int4 pipeline in)."""
 
     mesh_key: Tuple[Any, ...]
     variant: str          # "sp_pr" | "sp_rep" | "mp"
@@ -142,6 +178,7 @@ class GroupSpec:
     shapes: Tuple[Tuple[int, ...], ...]
     donate: Tuple[bool, ...]
     hier: Optional[Hierarchy] = None
+    quant: Optional[_compression.WireFormat] = None
 
 
 @dataclass
@@ -170,6 +207,15 @@ class MegakernelStats:
     # the first training step.
     warm_starts: int = 0
     warm_seconds: float = 0.0
+    # Bytes-on-wire accounting (quantized allreduce): logical_bytes is
+    # what the collective's payload traversals would move uncompressed,
+    # wire_bytes what they move in the launched kernels' wire formats
+    # (codes + block scales; per-leg on hierarchical launches).  The
+    # ratio is surfaced as the compression.ratio gauge and in
+    # bench.py --mode dataplane's bytes-on-wire section.
+    logical_bytes: int = 0
+    wire_bytes: int = 0
+    quant_launches: int = 0
 
 
 stats = MegakernelStats()
@@ -178,6 +224,23 @@ _lock = _lockorder.make_lock("megakernel._lock")
 _compiled: Dict[GroupSpec, Callable] = {}  # guarded_by: _lock
 _digests: Dict[GroupSpec, str] = {}  # guarded_by: _lock
 _by_digest: Dict[str, GroupSpec] = {}  # guarded_by: _lock
+# Error-feedback residual state (quantized allreduce), owned by the
+# executor: ONE flat buffer per fusion group, keyed
+# ("g", process_set_id, name_1, ..., name_k) — the concatenation of the
+# group's per-tensor residuals in pack order (per-tensor kernel
+# arguments would double the executable's arity and jax's per-array
+# dispatch cost; the steady state's grouping is stable thanks to the
+# PR 2 cached fusion plans, and a re-partition resets the affected
+# tensors' error history to zero, which costs one step of correction,
+# never correctness).  Flushed with the executable cache (plan
+# invalidation re-partitions groups) and checkpoint-restorable via
+# compression_state()/load_compression_state.
+_residuals: Dict[Tuple, Any] = {}  # guarded_by: _lock
+# Per-fusion-group launch counters: the stochastic-rounding tick.  The
+# kernel takes (seed, tick) as a runtime input, so one compiled
+# executable serves every step while the noise stays step-unique and —
+# under a fixed HVD_TPU_QUANT_SEED — bitwise reproducible.
+_ticks: Dict[Tuple, int] = {}  # guarded_by: _lock
 # Donation-safety probes (tests): weakrefs of the inputs donated by the
 # most recent launch — after the launch nothing in the runtime may hold
 # them, so post-gc the refs must be dead.  Only recorded while dispatch
@@ -186,27 +249,137 @@ last_donated: List[weakref.ref] = []
 
 
 def dcn_compress_name() -> str:
-    return os.environ.get(DCN_COMPRESS_ENV, "none")
+    """The DCN-leg compressor name; "" when the knob is UNSET — unset
+    means "inherit the group's quantized format", while an explicit
+    ``none`` pins the leg to full precision (the opt-out)."""
+    return os.environ.get(DCN_COMPRESS_ENV, "")
+
+
+def ici_compress_name() -> str:
+    return os.environ.get(ICI_COMPRESS_ENV, "none")
 
 
 def flush(reason: str) -> None:
-    """Drop every compiled executable (the plan-memo invalidation hook:
-    fusion-threshold changes re-partition groups, so the old structures
-    go cold — reclaim them instead of aging them out)."""
+    """Drop every compiled executable AND the quantization state (the
+    plan-memo invalidation hook: fusion-threshold changes re-partition
+    groups, so the old structures — and the error-feedback residuals
+    accumulated against them — go cold; reclaim instead of aging
+    out)."""
     with _lock:
         n = len(_compiled)
+        nr = len(_residuals)
         _compiled.clear()
         _digests.clear()
         _by_digest.clear()
+        _residuals.clear()
+        _ticks.clear()
         stats.flushes += 1
-    if n:
+    if n or nr:
         print(f"[hvd-megakernel] cache flushed ({reason}): "
-              f"{n} executables dropped", file=sys.stderr)
+              f"{n} executables, {nr} residual tensors dropped",
+              file=sys.stderr)
 
 
 def cache_size() -> int:
     with _lock:
         return len(_compiled)
+
+
+# ---------------------------------------------------------------------------
+# Quantization state: error-feedback residuals + stochastic-rounding ticks
+# ---------------------------------------------------------------------------
+
+def next_tick(group_key: Tuple) -> int:
+    """This launch's stochastic-rounding tick for one fusion group
+    (0, 1, 2, ... per group identity) — both executor paths (fused and
+    eager-reference) draw from the same counter, so the noise stream is
+    a property of the PROGRAM, not of which executor ran it."""
+    with _lock:
+        t = _ticks.get(group_key, 0)
+        _ticks[group_key] = t + 1
+        return t
+
+
+def take_residual(key: Tuple, dtype,
+                  shapes: Sequence[Tuple[int, ...]]) -> Optional[Any]:
+    """REMOVE and return the stored error-feedback residual for
+    ``key``, or None when absent/stale (first use, post-flush, changed
+    group shape).  Take-semantics on purpose: the caller donates the
+    buffer into the launch, and the store must never keep a reference
+    to soon-to-be-deleted device memory — a concurrent
+    :func:`compression_state` (e.g. the background-checkpoint snapshot)
+    would otherwise read a deleted array.  ``shapes`` lists the
+    acceptable shapes (the mp path accepts both its live [P, T] global
+    array and a checkpoint-restored local [T])."""
+    with _lock:
+        r = _residuals.pop(key, None)
+    if r is None \
+            or not any(tuple(r.shape) == tuple(s) for s in shapes) \
+            or str(r.dtype) != str(jnp.dtype(dtype)) \
+            or (isinstance(r, jax.Array) and r.is_deleted()):
+        return None
+    return r
+
+
+def store_residuals(keys: Sequence[Tuple], arrays: Sequence) -> None:
+    with _lock:
+        for key, arr in zip(keys, arrays):
+            _residuals[key] = arr
+
+
+def drop_residuals(keys: Sequence[Tuple]) -> None:
+    """Forget residual entries whose buffers were donated into a launch
+    that then FAILED — they reference deleted device memory and must
+    restart from zero rather than poison the next launch."""
+    with _lock:
+        for key in keys:
+            _residuals.pop(key, None)
+
+
+def residual_count() -> int:
+    with _lock:
+        return len(_residuals)
+
+
+def compression_state() -> Dict[str, Dict[str, Any]]:
+    """Checkpoint-portable snapshot of the quantization state: the
+    error-feedback residuals (host numpy) and per-group ticks.  Save it
+    alongside the model tree and hand it back to
+    :func:`load_compression_state` after restore, so a resumed run
+    continues the telescoping error correction instead of restarting it
+    (exported as ``hvd.compression_state``)."""
+    import numpy as np
+
+    with _lock:
+        items = list(_residuals.items())
+        ticks = {json.dumps(list(k)): int(v) for k, v in _ticks.items()}
+    res = {}
+    for k, v in items:
+        if isinstance(v, jax.Array):
+            if v.is_deleted():
+                continue  # donated into an in-flight launch: skip
+            if not v.is_fully_addressable:
+                # mp residual: a [P, T] global — export this process's
+                # local [T] shard (what the restore path re-uploads).
+                v = np.asarray(v.addressable_data(0))[0]
+        res[json.dumps(list(k))] = np.asarray(v)
+    return {"residuals": res, "ticks": ticks}
+
+
+def load_compression_state(state: Dict[str, Dict[str, Any]]) -> None:
+    """Restore a :func:`compression_state` snapshot (exported as
+    ``hvd.load_compression_state``)."""
+    import numpy as np
+
+    res = {tuple(json.loads(k)): np.asarray(v)
+           for k, v in (state.get("residuals") or {}).items()}
+    ticks = {tuple(json.loads(k)): int(v)
+             for k, v in (state.get("ticks") or {}).items()}
+    with _lock:
+        _residuals.clear()
+        _residuals.update(res)
+        _ticks.clear()
+        _ticks.update(ticks)
 
 
 def digest_of(spec: GroupSpec) -> Optional[str]:
@@ -223,17 +396,27 @@ def spec_for_digest(digest: str) -> Optional[GroupSpec]:
         return _by_digest.get(digest)
 
 
-def plan_digest(entries: Sequence[_program.SignatureEntry]) -> str:
+def plan_digest(entries: Sequence[_program.SignatureEntry],
+                quant: Optional[_compression.WireFormat] = None) -> str:
     """The PR 2 fusion-plan digest of a group's signature entries
     (analysis/program.py's canonical scheme, shared with
     ops/cache.cycle_digest so cache diagnostics and executable records
-    name a cycle identically)."""
-    return _program.entries_digest(list(entries))
+    name a cycle identically).  The quantization spec is folded in —
+    the same tensor program under a different codebook is a different
+    compiled plan, and their records must never collide."""
+    base = _program.entries_digest(list(entries))
+    if quant is None:
+        return base
+    import hashlib
+
+    return hashlib.sha256(
+        f"{base}|{quant}".encode("utf-8")).hexdigest()[:len(base)]
 
 
 @functools.lru_cache(maxsize=64)
 def _hierarchy_cached(mesh_key: Tuple, dtype: str, mode: str,
-                      virtual: str, compress: str) -> Optional[Hierarchy]:
+                      virtual: str, dcn: str, ici: str,
+                      group_name: str) -> Optional[Hierarchy]:
     # The env values are part of the key, so this memo needs no
     # invalidation: a changed knob is a different key (the O(n) device
     # scan + group-tuple construction runs once per configuration, not
@@ -241,26 +424,48 @@ def _hierarchy_cached(mesh_key: Tuple, dtype: str, mode: str,
     h = _topology.replica_hierarchy(mesh_key)
     if h is None:
         return None
-    wire = _compression.wire_dtype_for(compress, jnp.dtype(dtype))
+
+    def quant_fmt(name):
+        # Leg formats gate on dtype only — the whole fusion buffer
+        # rides the leg, so the per-tensor min-elems floor is moot.
+        fmt = _compression.wire_format_for(name, jnp.dtype(dtype),
+                                           1 << 30)
+        return fmt if fmt is not None and fmt.kind == "quant" else None
+
+    wire = _compression.wire_dtype_for(dcn or "none", jnp.dtype(dtype))
+    dcn_q = quant_fmt(dcn) if dcn else None
+    if dcn == "" and dcn_q is None and wire is None and group_name:
+        # Per-leg composition default: a group whose policy selected a
+        # quantized format keeps it on the slow DCN leg when
+        # HVD_TPU_DCN_COMPRESS is UNSET; an explicit value — including
+        # ``none`` — overrides (the full-precision-DCN opt-out).  The
+        # ICI legs stay full precision unless HVD_TPU_ICI_COMPRESS
+        # opts them in.
+        dcn_q = quant_fmt(group_name)
     return Hierarchy(
         topo=h,
-        wire_dtype=(jnp.dtype(wire).name if wire is not None else None))
+        wire_dtype=(jnp.dtype(wire).name if wire is not None else None),
+        dcn_quant=dcn_q, ici_quant=quant_fmt(ici))
 
 
-def hierarchy_for(mesh_devices: Tuple, op: str,
-                  dtype) -> Optional[Hierarchy]:
+def hierarchy_for(mesh_devices: Tuple, op: str, dtype,
+                  group_fmt=None) -> Optional[Hierarchy]:
     """The hierarchical-reduction plan for one group, or None for flat.
 
     Only the psum family decomposes (SUM/AVERAGE — the gradient path);
-    the DCN wire dtype applies the compression.py applicability rule to
-    the group's dtype at plan time so the kernel folds the casts."""
+    each leg's wire format applies the compression.py applicability
+    rule to the group's dtype at plan time so the kernel folds the
+    casts/codecs.  ``group_fmt`` (the group's policy WireFormat) feeds
+    the DCN-leg inheritance default."""
     if op != "psum":
         return None
     return _hierarchy_cached(
         tuple(mesh_devices), jnp.dtype(dtype).name,
         os.environ.get(_topology.HIERARCHICAL_ENV, "auto"),
         os.environ.get(_topology.VIRTUAL_SLICES_ENV, ""),
-        dcn_compress_name())
+        dcn_compress_name(), ici_compress_name(),
+        group_fmt.name if (group_fmt is not None
+                           and group_fmt.kind == "quant") else "")
 
 
 # ---------------------------------------------------------------------------
@@ -310,6 +515,17 @@ def _reduce_flat(spec: GroupSpec):
                                axis_index_groups=ici)
         return g[:L] if pad else g
 
+    if spec.quant is not None and spec.quant.kind == "cast":
+        # Policy-selected cast compression (bf16/fp16): the whole
+        # reduction runs in the wire dtype, restored on unpack —
+        # decompress-then-divide order, like the eager compressors.
+        wire = jnp.dtype(spec.quant.wire_dtype)
+        inner = reduce_fn
+
+        def reduce_cast(v):
+            return inner(v.astype(wire)).astype(v.dtype)
+
+        return reduce_cast
     return reduce_fn
 
 
@@ -330,9 +546,179 @@ def _unpack(spec: GroupSpec, red, lead: Tuple[int, ...]):
     return tuple(outs)
 
 
+def _needs_quant_build(spec: GroupSpec) -> bool:
+    if spec.quant is not None and spec.quant.kind == "quant":
+        return True
+    h = spec.hier
+    return h is not None and (h.dcn_quant is not None
+                              or h.ici_quant is not None)
+
+
+def _quant_unit(spec: GroupSpec) -> int:
+    """Flat-buffer alignment so every exchange chunk is a whole number
+    of scaling blocks: n·block for the flat two-phase exchange,
+    ici_size·block for the hierarchical legs."""
+    blocks = [f.block for f in (
+        spec.quant, spec.hier.dcn_quant if spec.hier else None,
+        spec.hier.ici_quant if spec.hier else None)
+        if f is not None and f.kind == "quant"]
+    block = max(blocks) if blocks else 2
+    n = spec.hier.topo.ici_size if spec.hier is not None \
+        else len(spec.mesh_key)
+    return n * block
+
+
+def _hier_quant_reduce(vin, spec: GroupSpec, key, pos):
+    """Hierarchical ICI×DCN reduction with per-leg wire formats: the
+    scatter and gather legs ride ICI (full precision, or int8/int4 via
+    HVD_TPU_ICI_COMPRESS), the cross-slice sum rides DCN in its own
+    format (cast or quantized).  Returns the reduced [Tp] float32."""
+    hier = spec.hier
+    topo = hier.topo
+    ici = [list(g) for g in topo.ici_groups]
+    dcn = [list(g) for g in topo.dcn_groups]
+    myslice = jnp.take(
+        jnp.asarray(topo.slice_of_positions(), dtype=jnp.int32), pos)
+    if hier.ici_quant is not None:
+        frag = _compression.quantized_scatter_sum(
+            vin, hier.ici_quant, key, axis=REPLICA_AXIS,
+            n=topo.ici_size, noise_pos=pos, groups=ici)
+    else:
+        frag = jax.lax.psum_scatter(
+            vin, REPLICA_AXIS, scatter_dimension=0, tiled=True,
+            axis_index_groups=ici).astype(jnp.float32)
+    if hier.dcn_quant is not None:
+        frag = _compression.quantized_gather_sum(
+            frag, hier.dcn_quant, key, axis=REPLICA_AXIS, pos=myslice,
+            groups=dcn)
+    elif hier.wire_dtype is not None:
+        frag = jax.lax.psum(
+            frag.astype(jnp.dtype(hier.wire_dtype)), REPLICA_AXIS,
+            axis_index_groups=dcn).astype(jnp.float32)
+    else:
+        frag = jax.lax.psum(frag, REPLICA_AXIS, axis_index_groups=dcn)
+    if hier.ici_quant is not None:
+        return _compression.quantized_all_gather(
+            frag, hier.ici_quant, key, axis=REPLICA_AXIS, pos=pos,
+            groups=ici)
+    return jax.lax.all_gather(frag, REPLICA_AXIS, axis=0, tiled=True,
+                              axis_index_groups=ici)
+
+
+def _build_quant(spec: GroupSpec, mesh) -> Callable:
+    """Trace + wrap one QUANTIZED group executable: pack → (residual
+    add) → quantize → wire exchange → dequantize → unpack, all in the
+    same single XLA program as the uncompressed megakernel — the
+    quantize/dequantize stages cost zero extra dispatches.
+
+    Signature per variant (``st`` = uint32[2] (seed, tick) — a runtime
+    input, so one executable serves every step):
+
+    =========  =================================================
+    sp_pr      (t_1..t_k[, res], st) → (o_1..o_k[, res'])
+    sp_rep     same, replicated layouts
+    mp         (buf[, res], st) → (o_1..o_k[, res'])
+    =========  =================================================
+
+    ``res`` is the error-feedback residual as ONE flat buffer per
+    group ([n, T] per-replica / [T] replicated) — per-TENSOR residual
+    arrays would double the executable's argument count and pay jax's
+    per-array dispatch cost twice over; the flat buffer is their exact
+    concatenation, group-keyed in the executor's store.  Residual IO
+    exists only on the error-feedback path (flat quantized reduction);
+    the hierarchical per-leg codecs rely on stochastic rounding alone
+    (docs/tensor-fusion.md)."""
+    fmt = spec.quant if (spec.quant is not None
+                         and spec.quant.kind == "quant") else None
+    cast = spec.quant if (spec.quant is not None
+                          and spec.quant.kind == "cast") else None
+    hier = spec.hier
+    n = len(spec.mesh_key)
+    k = len(spec.shapes)
+    T = sum(_numel(s) for s in spec.shapes)
+    dtype = jnp.dtype(spec.dtype)
+    use_ef = fmt is not None and fmt.error_feedback and hier is None
+    shared = spec.variant == "sp_rep"
+    pad = (-T) % _quant_unit(spec)
+
+    def reduce_local(v, r, st):
+        key = _compression.step_key(st[0], st[1])
+        vin = v + r if r is not None else v
+        if cast is not None:
+            vin = vin.astype(jnp.dtype(cast.wire_dtype))
+        if pad:
+            vin = jnp.concatenate([vin, jnp.zeros((pad,), vin.dtype)])
+        pos = jax.lax.axis_index(REPLICA_AXIS)
+        if hier is None:
+            red, r_new = _compression.quantized_reduce_collective(
+                vin, fmt, key, axis=REPLICA_AXIS, n=n, my_chunk=pos,
+                noise_pos=0 if shared else pos, error_feedback=use_ef,
+                phase2_feedback=use_ef and not shared)
+        else:
+            red = _hier_quant_reduce(vin, spec, key, pos)
+            r_new = None
+        red = red[:T].astype(dtype)
+        return red, (r_new[:T] if r_new is not None else None)
+
+    nin = k + (1 if use_ef else 0)
+    if spec.variant in ("sp_pr", "sp_rep"):
+        lead = (1,) if spec.variant == "sp_pr" else ()
+
+        def body(*args):
+            ts, st = args[:k], args[-1]
+            res = args[k] if use_ef else None
+            if spec.variant == "sp_pr":
+                v = jnp.squeeze(jnp.concatenate(
+                    [t.reshape((t.shape[0], -1)) for t in ts], axis=1), 0)
+                r = jnp.squeeze(res, 0) if use_ef else None
+            else:
+                v = jnp.concatenate([t.reshape(-1) for t in ts])
+                r = res
+            red, r_new = reduce_local(v, r, st)
+            outs = _unpack(spec, red[None] if lead else red, lead)
+            if use_ef:
+                outs = outs + ((r_new[None] if lead else r_new),)
+            return outs
+
+        part = P(REPLICA_AXIS) if spec.variant == "sp_pr" else P()
+        in_specs = tuple(part for _ in range(nin)) + (P(),)
+        out_specs = tuple(part for _ in range(nin))
+    elif spec.variant == "mp":
+        def body(*args):
+            buf = args[0]
+            res = args[1] if use_ef else None
+            st = args[-1]
+            v = jnp.squeeze(buf, 0)
+            r = jnp.squeeze(res, 0) if use_ef else None
+            red, r_new = reduce_local(v, r, st)
+            outs = _unpack(spec, red, ())
+            if use_ef:
+                outs = outs + (r_new[None],)
+            return outs
+
+        in_specs = (P(REPLICA_AXIS),) \
+            + ((P(REPLICA_AXIS),) if use_ef else ()) + (P(),)
+        out_specs = tuple(P() for _ in spec.shapes) \
+            + ((P(REPLICA_AXIS),) if use_ef else ())
+    else:
+        raise ValueError(f"unknown megakernel variant {spec.variant!r}")
+
+    if spec.variant == "mp":
+        donate = (0, 1) if use_ef else (0,)
+    else:
+        donate = tuple(i for i, d in enumerate(spec.donate) if d) \
+            + ((k,) if use_ef else ())  # the residual is executor-owned
+    return jax.jit(
+        _compat.shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False),
+        donate_argnums=donate)
+
+
 def _build(spec: GroupSpec, mesh) -> Callable:
     """Trace + wrap one group executable: pack → reduce → unpack in a
     single XLA program over ``mesh``, donated on the owned inputs."""
+    if _needs_quant_build(spec):
+        return _build_quant(spec, mesh)
     reduce_fn = _reduce_flat(spec)
 
     if spec.variant == "sp_pr":
@@ -460,6 +846,8 @@ def _mesh_fingerprint(mesh_key) -> dict:
 
 
 def _manifest_entry(spec: GroupSpec, digest: Optional[str]) -> dict:
+    from dataclasses import asdict
+
     return {
         "variant": spec.variant,
         "op": spec.op,
@@ -469,6 +857,7 @@ def _manifest_entry(spec: GroupSpec, digest: Optional[str]) -> dict:
         "shapes": [list(s) for s in spec.shapes],
         "donate": list(spec.donate),
         "hier": spec.hier is not None,
+        "quant": asdict(spec.quant) if spec.quant is not None else None,
         "digest": digest,
         "mesh": _mesh_fingerprint(spec.mesh_key),
     }
@@ -516,16 +905,34 @@ def _record_manifest(spec: GroupSpec, digest: Optional[str]) -> None:
 
 def _warm_avals(spec: GroupSpec, mesh) -> List[jax.ShapeDtypeStruct]:
     """Abstract inputs for AOT-lowering one recorded group executable
-    (global shapes + shardings exactly as launch() passes them)."""
+    (global shapes + shardings exactly as launch() passes them —
+    including the residual mirrors and the (seed, tick) state input on
+    the quantized signatures)."""
     n = len(spec.mesh_key)
     dtype = jnp.dtype(spec.dtype)
     if spec.variant == "sp_pr":
         sh = NamedSharding(mesh, P(REPLICA_AXIS))
-        return [jax.ShapeDtypeStruct((n,) + shp, dtype, sharding=sh)
-                for shp in spec.shapes]
-    sh = NamedSharding(mesh, P())
-    return [jax.ShapeDtypeStruct(shp, dtype, sharding=sh)
-            for shp in spec.shapes]
+        avals = [jax.ShapeDtypeStruct((n,) + shp, dtype, sharding=sh)
+                 for shp in spec.shapes]
+    else:
+        sh = NamedSharding(mesh, P())
+        avals = [jax.ShapeDtypeStruct(shp, dtype, sharding=sh)
+                 for shp in spec.shapes]
+    if _needs_quant_build(spec):
+        fmt = spec.quant
+        if (fmt is not None and fmt.kind == "quant"
+                and fmt.error_feedback and spec.hier is None):
+            T = sum(_numel(s) for s in spec.shapes)
+            if spec.variant == "sp_pr":
+                avals.append(jax.ShapeDtypeStruct(
+                    (n, T), dtype,
+                    sharding=NamedSharding(mesh, P(REPLICA_AXIS))))
+            else:
+                avals.append(jax.ShapeDtypeStruct(
+                    (T,), dtype, sharding=NamedSharding(mesh, P())))
+        avals.append(jax.ShapeDtypeStruct(
+            (2,), jnp.uint32, sharding=NamedSharding(mesh, P())))
+    return avals
 
 
 def warm_start(mesh, directory: Optional[str] = None) -> int:
@@ -552,13 +959,17 @@ def warm_start(mesh, directory: Optional[str] = None) -> int:
         if entry.get("variant") not in ("sp_pr", "sp_rep"):
             continue
         try:
+            quant = (_compression.WireFormat(**entry["quant"])
+                     if entry.get("quant") else None)
             spec = GroupSpec(
                 mesh_key=mesh_key, variant=entry["variant"],
                 op=entry["op"], average=bool(entry["average"]),
                 denom=int(entry["denom"]), dtype=entry["dtype"],
                 shapes=tuple(tuple(s) for s in entry["shapes"]),
                 donate=tuple(bool(x) for x in entry["donate"]),
-                hier=hierarchy_for(mesh_key, entry["op"], entry["dtype"]))
+                hier=hierarchy_for(mesh_key, entry["op"], entry["dtype"],
+                                   group_fmt=quant),
+                quant=quant)
             with _lock:
                 if spec in _compiled:
                     continue
@@ -578,15 +989,60 @@ def warm_start(mesh, directory: Optional[str] = None) -> int:
     return warmed
 
 
+def wire_accounting(spec: GroupSpec) -> Tuple[int, int]:
+    """``(logical_bytes, wire_bytes)`` one launch of ``spec`` moves.
+
+    The model counts payload traversals per leg — flat reductions make
+    two (the scatter- and gather-phase of a bandwidth-optimal
+    allreduce), hierarchical ones two ICI traversals plus the 1/ici
+    DCN fragment — each in that leg's wire format (codes + one 2-byte
+    scale per block for quantized legs).  The per-member (n−1)/n factor
+    is common to both figures and cancels in the ratio
+    (docs/metrics.md)."""
+    T = sum(_numel(s) for s in spec.shapes)
+    item = jnp.dtype(spec.dtype).itemsize
+
+    def fmt_bytes(count: int, fmt) -> int:
+        if fmt is None:
+            return count * item
+        if fmt.kind == "cast":
+            return count * (fmt.bits // 8)
+        return (count * fmt.bits + 7) // 8 + (-(-count // fmt.block)) * 2
+
+    if spec.hier is None:
+        return 2 * T * item, 2 * fmt_bytes(T, spec.quant)
+    h = spec.hier
+    F = -(-T // h.topo.ici_size)
+    cast = spec.quant if (spec.quant is not None
+                          and spec.quant.kind == "cast") else None
+    ici_f = h.ici_quant or cast
+    if h.dcn_quant is not None:
+        dcn_f = h.dcn_quant
+    elif h.wire_dtype is not None:
+        dcn_f = _compression.WireFormat(
+            kind="cast", name=h.wire_dtype, wire_dtype=h.wire_dtype,
+            bits=8 * jnp.dtype(h.wire_dtype).itemsize,
+            stochastic=False, error_feedback=False)
+    else:
+        dcn_f = cast
+    logical = (2 * T + F) * item
+    return logical, 2 * fmt_bytes(T, ici_f) + fmt_bytes(F, dcn_f)
+
+
 def launch(spec: GroupSpec, mesh, values: Sequence,
-           digest_fn: Optional[Callable[[], str]] = None):
+           digest_fn: Optional[Callable[[], str]] = None,
+           donate_mask: Optional[Sequence[bool]] = None):
     """One megakernel dispatch for a fusion group.  Under dispatch
     counting (tests/bench) the launch is wrapped in a thread-local
     window and the observed executable count is accumulated on
     ``stats`` — the "exactly one dispatch per group" regression
     contract — and the donated inputs are recorded as weakrefs for the
-    use-after-donate probe."""
+    use-after-donate probe.  ``donate_mask`` extends ``spec.donate``
+    when the quantized kernels append executor-owned inputs (residuals)
+    beyond the per-tensor contributions."""
     fn, cold = executable(spec, mesh, digest_fn)
+    mask = tuple(donate_mask) if donate_mask is not None else spec.donate
+    logical_b, wire_b = wire_accounting(spec)
 
     def dispatch():
         # XLA compiles on the cold executable's FIRST dispatch; time
@@ -603,21 +1059,31 @@ def launch(spec: GroupSpec, mesh, values: Sequence,
     counting = _xla_dispatch.counting_enabled()
     if counting:
         probes = [weakref.ref(v)
-                  for v, d in zip(values, spec.donate) if d]
+                  for v, d in zip(values, mask) if d]
         with _xla_dispatch.record() as scope:
             outs = dispatch()
         with _lock:
             stats.launches += 1
             stats.launch_dispatches += scope.count
-            stats.donated_inputs += sum(spec.donate)
+            stats.donated_inputs += sum(mask)
+            stats.logical_bytes += logical_b
+            stats.wire_bytes += wire_b
             if spec.hier is not None:
                 stats.hier_launches += 1
+            if _needs_quant_build(spec):
+                stats.quant_launches += 1
             last_donated[:] = probes
     else:
         outs = dispatch()
         with _lock:
             stats.launches += 1
-            stats.donated_inputs += sum(spec.donate)
+            stats.donated_inputs += sum(mask)
+            stats.logical_bytes += logical_b
+            stats.wire_bytes += wire_b
             if spec.hier is not None:
                 stats.hier_launches += 1
+            if _needs_quant_build(spec):
+                stats.quant_launches += 1
+    if _telemetry.enabled():
+        _M_WIRE_BYTES.observe(wire_b)
     return outs

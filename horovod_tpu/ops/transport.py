@@ -39,6 +39,8 @@ from ..telemetry import flight as _flight
 from .wire import DEAD_PEER_MARKER, Request, Response, ResponseType
 
 FRAME_HELLO = 0       # worker→controller: <i rank><H len><hostname>
+                      #   <H len><env fingerprint> — the SPMD env-knob
+                      #   uniformity check (ops/compression.py)
 FRAME_REQUEST = 1     # worker→controller: packed Request
 FRAME_RESPONSES = 2   # controller→worker: packed response list
 FRAME_TOPO = 3        # controller→worker: <iiiii> local_rank local_size
@@ -129,6 +131,34 @@ def _recv_frame(sock: socket.socket):
     _M_RX.inc()
     _M_RX_BYTES.inc(_HDR.size + length)
     return ftype, payload
+
+
+def _check_env_fingerprint(rank: int, payload: bytes, offset: int) -> None:
+    """Cross-rank uniformity check of the SPMD-program-selecting env
+    knobs (compression/quantization/hierarchy — see
+    ops/compression.env_fingerprint): the worker's HELLO carries its
+    fingerprint; a divergence from the controller's means the ranks
+    would compile DIFFERENT collective programs — silent garbage or a
+    hang — so warn AT INIT naming the rank and every divergent knob."""
+    from . import compression as _compression
+
+    if len(payload) < offset + 2:
+        return  # pre-fingerprint HELLO (tests poking raw frames)
+    (flen,) = struct.unpack_from("<H", payload, offset)
+    theirs = payload[offset + 2:offset + 2 + flen].decode("utf-8")
+    mine = _compression.env_fingerprint()
+    if theirs == mine:
+        return
+    their_map = dict(kv.split("=", 1) for kv in theirs.split(";") if kv)
+    my_map = dict(kv.split("=", 1) for kv in mine.split(";") if kv)
+    diffs = [f"{k}: rank0={my_map.get(k, '?')} rank{rank}="
+             f"{their_map.get(k, '?')}"
+             for k in sorted(set(my_map) | set(their_map))
+             if my_map.get(k) != their_map.get(k)]
+    print(f"WARNING: rank {rank} disagrees with rank 0 on env knobs "
+          f"that change the compiled SPMD program — collectives WILL "
+          f"diverge (docs/performance.md \"Env-knob uniformity\"): "
+          f"{'; '.join(diffs)}", file=sys.stderr)
 
 
 @dataclass(frozen=True)
@@ -225,6 +255,7 @@ class ControllerTransport:
             (rank,) = struct.unpack_from("<i", payload)
             (hlen,) = struct.unpack_from("<H", payload, 4)
             hosts[rank] = payload[6:6 + hlen].decode("utf-8")
+            _check_env_fingerprint(rank, payload, 6 + hlen)
             socks[rank] = conn
         from . import cache as _cache_mod
 
@@ -617,8 +648,12 @@ class WorkerTransport:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._send_lock = _lockorder.make_lock("WorkerTransport._send_lock")
         hb = (hostname or socket.gethostname()).encode("utf-8")
+        from . import compression as _compression
+
+        fp = _compression.env_fingerprint().encode("utf-8")
         _send_frame(self._sock, FRAME_HELLO,
-                    struct.pack("<i", rank) + struct.pack("<H", len(hb)) + hb)
+                    struct.pack("<i", rank) + struct.pack("<H", len(hb))
+                    + hb + struct.pack("<H", len(fp)) + fp)
         ftype, payload = _recv_frame(self._sock)
         if ftype != FRAME_TOPO:
             raise RuntimeError(

@@ -207,5 +207,18 @@ def install_runtime_collector() -> None:
         reg.gauge("megakernel.hier_launches").set(ms.hier_launches)
         reg.gauge("megakernel.executables").set(_mk.cache_size())
         reg.gauge("megakernel.warm_starts").set(ms.warm_starts)
+        # Quantized allreduce (docs/metrics.md "Quantized reduction"):
+        # cumulative logical vs wire bytes and their ratio — with the
+        # identity compressor the ratio sits at 1.0; int8 ≈ 3.97, int4
+        # ≈ 7.9.  The per-launch distribution rides the
+        # collective.wire_bytes histogram (fed at launch time by the
+        # executor, not by this collector).
+        reg.gauge("megakernel.quant_launches").set(ms.quant_launches)
+        reg.gauge("megakernel.logical_bytes").set(ms.logical_bytes)
+        reg.gauge("megakernel.wire_bytes").set(ms.wire_bytes)
+        reg.gauge("megakernel.residual_tensors").set(_mk.residual_count())
+        reg.gauge("compression.ratio").set(
+            round(ms.logical_bytes / ms.wire_bytes, 4)
+            if ms.wire_bytes else 1.0)
 
     _default.register_collector("runtime", collect)

@@ -99,6 +99,28 @@ def test_bench_dataplane_mode_contract_and_gates():
     assert tel["megakernel_us_metrics_off"] > 0
     assert "overhead_pct" in tel
     assert tel["counters"].get("megakernel.launches", 0) >= 1, tel
+    # Bytes-on-wire accounting (ISSUE 6): per-compressor legs with
+    # logical vs wire bytes per cycle, the compression ratio, the
+    # eager-reference equality verdict, and the dispatch count proving
+    # the quantize pipeline stayed inside the one fused executable.
+    # Deterministic gates only — the throughput floor lives in CI.
+    compression = payload["compression"]
+    for codec in ("none", "int8", "int4"):
+        leg = compression[codec]
+        for key in ("cycle_us", "speedup_vs_uncompressed",
+                    "dispatches_per_cycle", "logical_bytes_per_cycle",
+                    "wire_bytes_per_cycle", "compression_ratio",
+                    "reference_equal"):
+            assert key in leg, (codec, leg)
+        assert leg["dispatches_per_cycle"] == 1, (codec, leg)
+    assert compression["none"]["compression_ratio"] == 1.0
+    assert compression["int8"]["compression_ratio"] >= 3.0, compression
+    assert compression["int4"]["compression_ratio"] >= 6.0, compression
+    assert compression["int8"]["reference_equal"] is True, compression
+    assert compression["int4"]["reference_equal"] is True, compression
+    assert compression["int8"]["wire_bytes_per_cycle"] \
+        < compression["none"]["wire_bytes_per_cycle"]
+    assert tel["counters"].get("compression.ratio", 0) >= 1.0, tel
 
 
 def test_bench_input_mode_contract_and_identity():

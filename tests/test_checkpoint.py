@@ -262,3 +262,37 @@ def test_compile_cache_manifest_ignores_foreign_mesh(tmp_path,
         assert mk.warm_start(horovod_tpu.mesh(), cache_dir) == 0
     finally:
         hvd.shutdown()
+
+
+def test_compression_state_rides_checkpoints(hvd, tmp_path, monkeypatch):
+    """Quantized-allreduce error-feedback residuals are
+    checkpoint-restorable: hvd.compression_state() serializes through
+    the normal save/restore path, and after load_compression_state()
+    the resumed step replays BITWISE (the EF chain continues instead of
+    restarting)."""
+    from horovod_tpu.ops import megakernel as mk
+
+    monkeypatch.setenv("HVD_TPU_COMPRESSION", "int8")
+    n = horovod_tpu.size()
+    rng = np.random.default_rng(21)
+    x = horovod_tpu.shard(rng.standard_normal((n, 48)).astype("float32"))
+    np.asarray(horovod_tpu.allreduce(x, average=False, name="ckq"))
+    snap = horovod_tpu.compression_state()
+    assert snap["residuals"]
+    path = str(tmp_path / "q.msgpack")
+    ck.save_checkpoint(path, {"params": _tree(), "quant": snap},
+                       block=True)
+    out_next = np.asarray(horovod_tpu.allreduce(x, average=False,
+                                                name="ckq"))
+
+    # Simulated relaunch: executor state gone, checkpoint restores it
+    # (flax restores by target structure — a snapshot with the same
+    # groups serves as the template, exactly as a resumed trainer's
+    # would).
+    mk.flush("test: relaunch")
+    restored = ck.restore_checkpoint(
+        path, {"params": _tree(), "quant": snap}, broadcast=False)
+    horovod_tpu.load_compression_state(restored["quant"])
+    out_resumed = np.asarray(horovod_tpu.allreduce(x, average=False,
+                                                   name="ckq"))
+    assert out_next.tobytes() == out_resumed.tobytes()

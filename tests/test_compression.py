@@ -243,3 +243,218 @@ def test_sparse_leaves_bypass_compression(hvd):
     # All 8 replicas contributed the same row; averaged update is -1.
     np.testing.assert_allclose(np.asarray(out)[1], -1.0)
     np.testing.assert_allclose(np.asarray(out)[0], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Quantized wire formats (ISSUE 6): registry, policy, standalone codec
+# ---------------------------------------------------------------------------
+
+from horovod_tpu.ops import compression as comp
+
+
+def test_resolve_error_names_every_compressor():
+    with pytest.raises(ValueError) as ei:
+        comp.resolve("int7")
+    msg = str(ei.value)
+    for name in ("none", "fp16", "bf16", "int8", "int4"):
+        assert name in msg, msg
+    # And the registry resolves every advertised name.
+    for name in comp.valid_names():
+        assert comp.resolve(name) is not None
+
+
+def test_quant_compressor_rejects_wrap_api():
+    """int8/int4 cannot wrap a sum collective the way casts do; the
+    error must point at the correct selection API."""
+    with pytest.raises(ValueError, match="set_compression"):
+        Compression.int8.compress(jnp.ones(4))
+    with pytest.raises(ValueError, match="int4"):
+        Compression.int4.compress(jnp.ones(4))
+
+
+@pytest.mark.parametrize("codec", ["int8", "int4"])
+def test_standalone_quantize_roundtrip(codec):
+    rng = np.random.default_rng(0)
+    t = jnp.asarray(rng.standard_normal((5, 37)).astype(np.float32))
+    cls = comp.resolve(codec)
+    wire_data, ctx = cls.quantize(t)
+    back = cls.dequantize(wire_data, ctx)
+    assert back.shape == t.shape and back.dtype == t.dtype
+    # Error bounded by one (power-of-two) quantization step per block.
+    step = 2.0 * np.abs(np.asarray(t)).max() / comp._levels(cls.bits)
+    assert np.abs(np.asarray(back) - np.asarray(t)).max() <= step
+
+
+def test_pack_int4_roundtrip():
+    q = jnp.asarray(np.random.default_rng(1).integers(
+        -7, 8, size=(3, 64)).astype(np.int8))
+    np.testing.assert_array_equal(
+        np.asarray(comp.unpack_int4(comp.pack_int4(q))), np.asarray(q))
+
+
+def test_wire_pack_roundtrip():
+    fmt = comp.wire_format("int8")
+    rng = np.random.default_rng(2)
+    rows = jnp.asarray(rng.standard_normal((4, 512)).astype(np.float32))
+    q, s = comp.quantize_blocks(rows, fmt, comp.step_key(0, 0))
+    w = comp.wire_pack(q, s, fmt)
+    assert w.dtype == jnp.uint8
+    assert w.shape[-1] == comp.wire_bytes_per_chunk(512, fmt)
+    q2, s2 = comp.wire_unpack(w, 512, fmt)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q2))
+    assert np.asarray(s).tobytes() == np.asarray(s2).tobytes()
+
+
+def test_pow2_scales_are_exact_in_bf16():
+    fmt = comp.wire_format("int8")
+    rng = np.random.default_rng(3)
+    rows = jnp.asarray((rng.standard_normal((2, 1024)) * 100)
+                       .astype(np.float32))
+    _, s = comp.quantize_blocks(rows, fmt, comp.step_key(0, 0))
+    sf = np.asarray(s.astype(jnp.float32))
+    nz = sf[sf > 0]
+    # Every scale is a power of two → mantissa bits all zero → the
+    # bfloat16 wire cast was lossless.
+    m, _ = np.frexp(nz)
+    assert np.all(m == 0.5)
+
+
+def test_stochastic_rounding_unbiased():
+    """floor(x + u8-dither) over many draws averages to x (the SR
+    contract the convergence story rests on)."""
+    fmt = comp.wire_format("int8")
+    x = jnp.full((1, 256), 0.35, jnp.float32) * 2.0  # 0.7 of a step
+    draws = []
+    for tick in range(200):
+        q, s = comp.quantize_blocks(x, fmt, comp.step_key(0, tick))
+        draws.append(np.asarray(comp.dequantize_blocks(q, s, fmt))[0, 0])
+    assert abs(np.mean(draws) - 0.7) < 0.02
+
+
+def test_wire_format_applicability():
+    # Quantization: floats only, above the min-elems floor.
+    assert comp.wire_format_for("int8", np.float32, 1024).bits == 8
+    assert comp.wire_format_for("int8", np.int32, 1024) is None
+    assert comp.wire_format_for("int8", np.float32, 4) is None
+    assert comp.wire_format_for("int4", jnp.bfloat16, 1024).bits == 4
+    # Casts keep the dtype-narrowing rule.
+    assert comp.wire_format_for("bf16", np.float32, 8).wire_dtype \
+        == "bfloat16"
+    assert comp.wire_format_for("bf16", jnp.bfloat16, 1024) is None
+    assert comp.wire_format_for("none", np.float32, 1024) is None
+
+
+def test_policy_precedence_and_process_sets(monkeypatch):
+    monkeypatch.setenv(comp.DEFAULT_ENV, "bf16")
+    try:
+        # Env default applies without a policy.
+        assert comp.policy_name_for("anything", 0) == "bf16"
+        hvd_policy = comp.CompressionPolicy(
+            default="int8",
+            rules=[(r"embedding", "int4"), (r"\bln\b|bias", "none")],
+            process_sets={3: "none"})
+        assert hvd_policy.name_for("model.embedding.w", 0) == "int4"
+        assert hvd_policy.name_for("model.ln.scale", 0) == "none"
+        assert hvd_policy.name_for("dense.kernel", 0) == "int8"
+        # Rules win over the per-set override; the override wins over
+        # the default.
+        assert hvd_policy.name_for("model.embedding.w", 3) == "int4"
+        assert hvd_policy.name_for("dense.kernel", 3) == "none"
+        # Typos fail at construction with the full name list.
+        with pytest.raises(ValueError, match="int8"):
+            comp.CompressionPolicy(default="int9")
+        with pytest.raises(ValueError):
+            comp.CompressionPolicy(rules=[("x", "bogus")])
+    finally:
+        comp.set_compression()
+
+
+def test_set_compression_flushes_executor_state(hvd):
+    from horovod_tpu.ops import megakernel as mk
+
+    flushes0 = mk.stats.flushes
+    comp.set_compression(default="int8")
+    try:
+        assert mk.stats.flushes > flushes0
+        assert comp.policy_name_for("w", 0) == "int8"
+    finally:
+        comp.set_compression()
+    assert comp.get_compression() is None
+
+
+def test_validate_env_rejects_typos(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_COMPRESSION", "int9")
+    with pytest.raises(ValueError, match="HVD_TPU_COMPRESSION"):
+        comp.validate_env()
+    monkeypatch.setenv("HVD_TPU_COMPRESSION", "int8")
+    monkeypatch.setenv("HVD_TPU_QUANT_ROUNDING", "sometimes")
+    with pytest.raises(ValueError, match="ROUNDING"):
+        comp.validate_env()
+    monkeypatch.setenv("HVD_TPU_QUANT_ROUNDING", "nearest")
+    monkeypatch.setenv("HVD_TPU_QUANT_BLOCK", "33")
+    with pytest.raises(ValueError, match="even block"):
+        comp.validate_env()
+    monkeypatch.setenv("HVD_TPU_QUANT_BLOCK", "128")
+    monkeypatch.setenv("HVD_TPU_DCN_COMPRESS", "gzip")
+    with pytest.raises(ValueError, match="HVD_TPU_DCN_COMPRESS"):
+        comp.validate_env()
+    monkeypatch.setenv("HVD_TPU_DCN_COMPRESS", "int4")
+    comp.validate_env()  # all well-formed now
+
+
+def test_validate_env_runs_at_init(monkeypatch):
+    """A typo'd compressor must fail hvd.init(), not the first
+    collective (the satellite fix: the old error was bare and late)."""
+    import jax
+
+    import horovod_tpu as hvd_api
+
+    monkeypatch.setenv("HVD_TPU_COMPRESSION", "int9")
+    with pytest.raises(ValueError, match="expected one of"):
+        hvd_api.init(devices=jax.devices())
+
+
+def test_env_fingerprint_covers_spmd_knobs(monkeypatch):
+    fp0 = comp.env_fingerprint()
+    assert "HVD_TPU_COMPRESSION=<unset>" in fp0 \
+        or "HVD_TPU_COMPRESSION=" in fp0
+    monkeypatch.setenv("HVD_TPU_COMPRESSION", "int8")
+    monkeypatch.setenv("HVD_TPU_VIRTUAL_SLICES", "2")
+    fp1 = comp.env_fingerprint()
+    assert fp1 != fp0
+    assert "HVD_TPU_COMPRESSION=int8" in fp1
+    assert "HVD_TPU_VIRTUAL_SLICES=2" in fp1
+
+
+def test_handshake_fingerprint_warning(monkeypatch, capsys):
+    """The control-plane HELLO carries the env fingerprint; a divergent
+    knob makes the controller print a WARNING naming the rank and the
+    knob (the env-knob uniformity contract, validated not just
+    documented)."""
+    import struct
+
+    from horovod_tpu.ops import transport as tp
+
+    def hello_payload(fp: str) -> bytes:
+        hb = b"host1"
+        fpb = fp.encode("utf-8")
+        return (struct.pack("<i", 3) + struct.pack("<H", len(hb)) + hb
+                + struct.pack("<H", len(fpb)) + fpb)
+
+    # Identical fingerprints: silent.
+    tp._check_env_fingerprint(
+        3, hello_payload(comp.env_fingerprint()), 11)
+    assert "WARNING" not in capsys.readouterr().err
+
+    # Divergent knob: warn, naming rank and knob with both values.
+    monkeypatch.setenv("HVD_TPU_COMPRESSION", "none")
+    theirs = comp.env_fingerprint().replace(
+        "HVD_TPU_COMPRESSION=none", "HVD_TPU_COMPRESSION=int8")
+    tp._check_env_fingerprint(3, hello_payload(theirs), 11)
+    err = capsys.readouterr().err
+    assert "WARNING" in err and "rank 3" in err
+    assert "HVD_TPU_COMPRESSION" in err and "int8" in err
+
+    # Pre-fingerprint HELLO (short payload): tolerated silently.
+    tp._check_env_fingerprint(1, struct.pack("<i", 1), 4)
+    assert "WARNING" not in capsys.readouterr().err
