@@ -1,0 +1,93 @@
+"""Serve a transformer LM with hvd-serve (docs/inference.md).
+
+Loads the serving-ready checkpoint `examples/transformer_lm.py --export`
+writes (params + model config + tokenizer metadata), builds a
+continuous-batching InferenceEngine over the local devices (tensor-
+parallel over a `model` mesh axis when --tp > 1), warm-starts it, and
+either answers one prompt (--prompt / --tokens) or runs the HTTP front
+door (--serve) with /generate, /metrics and /healthz on one port.
+
+Usage:
+  # train tiny + export, then one-shot generate:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/transformer_lm.py --export /tmp/lm-ckpt
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/serve_lm.py /tmp/lm-ckpt --tokens 5,3,8,1 -n 16
+
+  # HTTP server (POST {"text": ..., "max_tokens": N} to /generate):
+  python examples/serve_lm.py /tmp/lm-ckpt --serve --port 9100
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+
+from horovod_tpu.core.topology import make_mesh  # noqa: E402
+from horovod_tpu.serving import InferenceEngine, LMServer  # noqa: E402
+from horovod_tpu.serving.server import (decode_tokens,  # noqa: E402
+                                        encode_text)
+from horovod_tpu.utils.checkpoint import (  # noqa: E402
+    load_serving_checkpoint)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("checkpoint", help="directory written by "
+                                       "transformer_lm.py --export")
+    ap.add_argument("--prompt", type=str, default=None,
+                    help="text prompt (byte tokenizer; needs a "
+                         "vocab_size >= 256 model)")
+    ap.add_argument("--tokens", type=str, default=None,
+                    help="comma-separated token-id prompt")
+    ap.add_argument("-n", "--max-tokens", type=int, default=16)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--serve", action="store_true",
+                    help="run the HTTP front door instead of one shot")
+    ap.add_argument("--port", type=int, default=9100)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree (shards KV heads + "
+                         "attention/FFN over a 'model' mesh axis)")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="decode batch slots (continuous batching)")
+    args = ap.parse_args()
+
+    params, cfg, meta = load_serving_checkpoint(args.checkpoint)
+    mesh = None
+    if args.tp > 1:
+        mesh = make_mesh(data=1, model=args.tp,
+                         devices=jax.devices()[:args.tp])
+    engine = InferenceEngine(params, cfg, mesh=mesh,
+                             max_slots=args.slots)
+
+    if args.serve:
+        server = LMServer(engine, port=args.port).start()
+        print(f"serve_lm: listening on :{server.port} "
+              f"(/generate /metrics /healthz), "
+              f"{meta['tokenizer']['kind']} tokenizer, "
+              f"tp={args.tp}, slots={args.slots}", flush=True)
+        try:
+            server._thread.join()
+        except KeyboardInterrupt:
+            server.close()
+        return
+
+    if args.tokens:
+        prompt = [int(t) for t in args.tokens.split(",")]
+    elif args.prompt is not None:
+        prompt = encode_text(args.prompt, cfg.vocab_size)
+    else:
+        ap.error("need --prompt or --tokens (or --serve)")
+    engine.warm_start()
+    out = engine.generate(prompt, max_new_tokens=args.max_tokens,
+                          temperature=args.temperature)
+    text = decode_tokens(out, cfg.vocab_size)
+    print(json.dumps({"prompt": prompt, "tokens": out, "text": text}))
+    print("serve_lm: OK")
+
+
+if __name__ == "__main__":
+    main()

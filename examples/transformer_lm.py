@@ -51,6 +51,11 @@ def main():
     ap.add_argument("--loss-chunk", type=int, default=0,
                     help="chunked cross-entropy: never materialize the "
                          "full [batch, seq, vocab] logits")
+    ap.add_argument("--export", type=str, default=None, metavar="DIR",
+                    help="after training, write a serving-ready "
+                         "checkpoint (params + model config + tokenizer "
+                         "metadata) that examples/serve_lm.py loads "
+                         "end-to-end")
     args = ap.parse_args()
 
     hvd.init()
@@ -124,6 +129,16 @@ def main():
               f"({n_params/1e6:.1f}M params, mesh={dict(zip(mesh.axis_names, mesh.devices.shape))})")
         assert final < first, "loss did not improve"
         print("transformer_lm: OK")
+    if args.export:
+        from horovod_tpu.utils.checkpoint import save_serving_checkpoint
+
+        tokenizer = "byte" if cfg.vocab_size >= 256 else "ids"
+        w = save_serving_checkpoint(args.export, params, cfg,
+                                    tokenizer=tokenizer,
+                                    extra={"trained_steps": steps},
+                                    block=True)
+        if w:
+            print(f"serving checkpoint exported: {args.export}")
     hvd.shutdown()
 
 

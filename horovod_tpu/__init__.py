@@ -110,5 +110,6 @@ from . import analysis  # noqa: F401  (hvd.analysis.verify_program & co)
 from .analysis.program import verify_program  # noqa: F401
 from . import telemetry  # noqa: F401  (hvd.telemetry.flight & registry)
 from .telemetry import cluster_metrics, metrics  # noqa: F401
+from . import serving  # noqa: F401  (hvd.serving.InferenceEngine & co)
 
 __version__ = "0.1.0"

@@ -339,6 +339,82 @@ class TrainerState(State):
         self._install()
 
 
+class ServingState(State):
+    """Elastic state for a serving fleet
+    (:class:`horovod_tpu.serving.engine.InferenceEngine`) — the resize
+    path of docs/inference.md: ``drain_commit()`` captures every queued
+    AND in-flight request (in-flight sequences become continuations
+    carrying what they already generated), publishes it through the
+    same background-writer commit as training state, and stops
+    admission; after the relaunch, ``sync()`` on a fresh engine
+    resubmits the committed work.  Greedy continuations reproduce the
+    uninterrupted rollout exactly (the serving bitwise contract), so a
+    fleet resize is invisible in the completions.
+
+    The request list rides the commit as a JSON blob in a uint8 array:
+    its LENGTH changes between commits, which the fixed-structure
+    pytree round trip of :class:`State` tolerates only for raw array
+    leaves.
+
+    Usage (mirrors :class:`TrainerState`)::
+
+        engine = serving.InferenceEngine(params, cfg, ...)
+        state = elastic.ServingState(engine)
+        ...
+        # on resize/failure:
+        state.drain_commit(); state.wait_committed()
+        # relaunched incarnation:
+        state = elastic.ServingState(fresh_engine)
+        state.sync()          # resubmits the committed requests
+    """
+
+    def __init__(self, engine: Any, **extra: Any) -> None:
+        object.__setattr__(self, "_engine", engine)
+        super().__init__(requests_blob=self._blob(), **extra)
+
+    def _blob(self, exported: Optional[List[dict]] = None) -> Any:
+        import json
+
+        if exported is None:
+            exported = self._engine.export_requests()
+        return np.frombuffer(json.dumps(exported).encode(),
+                             np.uint8).copy()
+
+    def _capture(self) -> None:
+        self._values["requests_blob"] = self._blob()
+
+    def _install(self) -> None:
+        import json
+
+        blob = bytes(np.asarray(self._values["requests_blob"]))
+        exported = json.loads(blob.decode() or "[]")
+        # Clear whatever the engine currently holds (retry path: the
+        # committed set replaces it wholesale), then resubmit.
+        self._engine.drain()
+        self._engine.import_requests(exported)
+
+    def commit(self) -> None:
+        self._capture()
+        super().commit()
+
+    def drain_commit(self) -> List[dict]:
+        """Resize step 1: drain the engine (stop admission, evict
+        in-flight sequences as continuations) and commit the captured
+        request set.  Returns the export for inspection/logging."""
+        exported = self._engine.drain()
+        self._values["requests_blob"] = self._blob(exported)
+        super().commit()
+        return exported
+
+    def restore(self) -> None:
+        super().restore()
+        self._install()
+
+    def sync(self) -> None:
+        super().sync()
+        self._install()
+
+
 def run(func: Callable) -> Callable:
     """Decorator making a training function elastic (≙
     ``@hvd.elastic.run``).

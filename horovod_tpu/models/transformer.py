@@ -373,3 +373,141 @@ def synthetic_lm_batch(key, global_batch: int, seq_len: int,
     tokens = jax.random.randint(key, (global_batch, seq_len + 1), 0,
                                 vocab_size)
     return tokens[:, :-1].astype(jnp.int32), tokens[:, 1:].astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Serving path: incremental decode against a fixed-capacity KV view
+# (hvd-serve, docs/inference.md).  These functions are the model half of
+# horovod_tpu/serving/: no shard_map, no flash attention — a plain
+# masked-softmax attention whose program is IDENTICAL between a
+# multi-token prefill and a one-token decode step, so the serving
+# engine's "prefill + N decode steps ≡ non-incremental forward" contract
+# can be tested (and CI-gated) bitwise.  Tensor parallelism for serving
+# comes from GSPMD sharding of the KV view's head axis
+# (serving/kv_cache.py reuses the parallel/tensor.py head-sharding
+# layout), not from shard_map.
+# ---------------------------------------------------------------------------
+
+
+def cache_attention(q, k_view, v_view, q_pos):
+    """Masked attention of ``q`` against a fixed-capacity KV view.
+
+    ``q``: ``[b, s, heads, head_dim]`` queries at global positions
+    ``q_pos`` (``[b, s]`` int32).  ``k_view``/``v_view``:
+    ``[b, capacity, heads, head_dim]`` — entry ``j`` holds the key/value
+    of global position ``j`` (the serving engine gathers its paged store
+    into this logical order first).  Cache-aware causal masking for
+    ragged batches: entry ``j`` participates in row ``(b, i)`` iff
+    ``j <= q_pos[b, i]`` — per-sequence lengths ride in through
+    ``q_pos``, so one program serves every slot-length mix.
+
+    Rows whose mask is empty (inactive serving slots with
+    ``q_pos < 0``) come out all-zero instead of NaN; active rows are
+    bitwise-unaffected by the guard (it only ever adds ``0.0``).
+    Softmax runs in float32 over the full capacity axis; masked entries
+    contribute exact zeros, so results do not depend on how much unused
+    capacity follows a sequence.
+    """
+    b, s, h, hd = q.shape
+    cap = k_view.shape[1]
+    scale = hd ** -0.5
+    scores = jnp.einsum(
+        "bshd,bchd->bhsc", q.astype(jnp.float32),
+        k_view.astype(jnp.float32)) * scale
+    kv_pos = jnp.arange(cap, dtype=jnp.int32)
+    mask = kv_pos[None, None, None, :] <= q_pos[:, None, :, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)  # all-masked rows: exp(-inf)
+    p = jnp.exp(scores - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    p = p / denom
+    out = jnp.einsum("bhsc,bchd->bshd", p, v_view.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def forward_step(params, tokens, start_pos, k_view, v_view,
+                 cfg: TransformerConfig):
+    """Cache-aware forward over ``tokens`` given already-cached context.
+
+    The ONE program both serving phases run: prefill calls it with the
+    whole (padded) prompt, decode with a single token per sequence —
+    same code path, so the two compose bitwise.
+
+    ``tokens``: ``[b, s]`` int32.  ``start_pos``: ``[b]`` int32 — the
+    global position of ``tokens[:, 0]``, which is also how many valid
+    entries the KV view already holds for that sequence (ragged across
+    the batch).  ``k_view``/``v_view``:
+    ``[n_layers, b, capacity, heads, head_dim]`` with positions
+    ``< start_pos`` populated.
+
+    Returns ``(logits [b, s, vocab] float32, k_new, v_new)`` where
+    ``k_new``/``v_new`` are ``[n_layers, b, s, heads, head_dim]`` — the
+    new tokens' entries, for the caller to scatter back into its paged
+    store (the view itself is a gather, not the storage).
+    """
+    if cfg.num_experts > 0:
+        raise ValueError("the serving path currently supports dense FFN "
+                         "layers only (num_experts == 0)")
+    b, s = tokens.shape
+    h_n, d = cfg.n_heads, cfg.d_model
+    if d % h_n != 0:
+        raise ValueError(f"d_model {d} not divisible by n_heads {h_n}")
+    hd = d // h_n
+    cap = k_view.shape[2]
+    if cap > cfg.max_seq_len:
+        raise ValueError(f"KV capacity {cap} exceeds cfg.max_seq_len "
+                         f"{cfg.max_seq_len}")
+    pos = start_pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    # Inactive slots carry start_pos < 0; clamp the embedding lookup
+    # (their rows are masked/garbage anyway, but the gather index must
+    # stay in range).
+    x = (params["embed"][tokens]
+         + jnp.take(params["pos_embed"], jnp.clip(pos, 0, None), axis=0))
+    ax = ParallelAxes(data=None)
+    k_news, v_news = [], []
+
+    def put(view_b, new_b, start_b):
+        return jax.lax.dynamic_update_slice_in_dim(
+            view_b, new_b, jnp.clip(start_b, 0, None), axis=0)
+
+    for i in range(cfg.n_layers):
+        lp = _index_layer(params["layers"], i)
+        h = _layernorm(x, lp["ln1"]["scale"], lp["ln1"]["bias"])
+        # Same fused [d, 3d] projection as the training forward.
+        qkv = jnp.dot(
+            h, jnp.concatenate([lp["wq"], lp["wk"], lp["wv"]], axis=-1),
+            preferred_element_type=jnp.float32).astype(x.dtype)
+        q, k, v = (y.reshape(b, s, h_n, hd)
+                   for y in jnp.split(qkv, 3, axis=-1))
+        k_full = jax.vmap(put)(k_view[i], k, start_pos)
+        v_full = jax.vmap(put)(v_view[i], v, start_pos)
+        attn = cache_attention(q, k_full, v_full, pos)
+        out = jnp.dot(attn.reshape(b, s, d), lp["wo"],
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+        x, _ = _ffn_block(x + out, lp, cfg, ax, jnp.zeros((), jnp.float32))
+        k_news.append(k)
+        v_news.append(v)
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = jnp.dot(x, params["unembed"],
+                     preferred_element_type=jnp.float32)
+    return logits, jnp.stack(k_news), jnp.stack(v_news)
+
+
+def serving_forward(params, tokens, cfg: TransformerConfig,
+                    capacity: Optional[int] = None):
+    """Non-incremental reference for the serving path: the full sequence
+    through :func:`forward_step` from an empty KV view.  Returns
+    ``logits [b, s, vocab]`` (float32).  The serving bitwise contract —
+    asserted by tests/test_serving.py and the serving bench — is that a
+    prefill of ``tokens[:, :p]`` followed by ``s - p`` single-token
+    decode steps reproduces these logits exactly."""
+    b, s = tokens.shape
+    cap = capacity if capacity is not None else s
+    hd = cfg.d_model // cfg.n_heads
+    zeros = jnp.zeros((cfg.n_layers, b, cap, cfg.n_heads, hd),
+                      cfg.dtype)
+    logits, _, _ = forward_step(
+        params, tokens, jnp.zeros((b,), jnp.int32), zeros, zeros, cfg)
+    return logits

@@ -874,17 +874,23 @@ def load_manifest(directory: str) -> List[dict]:
         return []
 
 
-def _record_manifest(spec: GroupSpec, digest: Optional[str]) -> None:
-    """Best-effort append of one cold build to the persistent-cache
-    manifest (dedup by structure, bounded, atomic rename; never takes
-    the executable lock — file IO must not nest inside it).  Only the
-    single-process group variants are recorded: the mp variant's mesh
-    and packed-buffer layout are incarnation-specific."""
-    d = compile_cache_dir()
-    if d is None or spec.variant not in ("sp_pr", "sp_rep"):
+def record_manifest_entry(entry: dict,
+                          directory: Optional[str] = None) -> None:
+    """Best-effort append of one executable record to the persistent-
+    cache manifest (dedup by structure — the ``digest`` field is
+    excluded from the key — bounded, atomic rename; never takes the
+    executable lock: file IO must not nest inside it).
+
+    Shared by the megakernel's cold-build recording and hvd-serve,
+    whose prefill/decode executables ride the SAME manifest under
+    ``variant: "serving"`` so one ``HVD_TPU_COMPILE_CACHE_DIR`` warms a
+    relaunched fleet's training AND serving programs
+    (:func:`warm_start` here skips serving entries;
+    ``serving.engine.InferenceEngine.warm_start`` consumes them)."""
+    d = directory or compile_cache_dir()
+    if d is None:
         return
     try:
-        entry = _manifest_entry(spec, digest)
         entries = load_manifest(d)
         key = {k: v for k, v in entry.items() if k != "digest"}
         if any({k: v for k, v in e.items() if k != "digest"} == key
@@ -901,6 +907,32 @@ def _record_manifest(spec: GroupSpec, digest: Optional[str]) -> None:
         os.replace(tmp, path)
     except Exception:  # noqa: BLE001 — the manifest is an optimization
         pass
+
+
+def serving_entries(directory: Optional[str] = None) -> List[dict]:
+    """The manifest's hvd-serve executable records (variant
+    ``"serving"``), for ``serving.engine.InferenceEngine.warm_start``."""
+    d = directory or compile_cache_dir()
+    if d is None:
+        return []
+    return [e for e in load_manifest(d)
+            if e.get("variant") == "serving"]
+
+
+def mesh_fingerprint(mesh_key) -> dict:
+    """Public alias of the manifest's mesh identity (platform, device
+    kind, count) — serving entries carry the same fingerprint."""
+    return _mesh_fingerprint(tuple(mesh_key))
+
+
+def _record_manifest(spec: GroupSpec, digest: Optional[str]) -> None:
+    """Record one cold megakernel build.  Only the single-process group
+    variants are recorded: the mp variant's mesh and packed-buffer
+    layout are incarnation-specific."""
+    if compile_cache_dir() is None or spec.variant not in ("sp_pr",
+                                                           "sp_rep"):
+        return
+    record_manifest_entry(_manifest_entry(spec, digest))
 
 
 def _warm_avals(spec: GroupSpec, mesh) -> List[jax.ShapeDtypeStruct]:

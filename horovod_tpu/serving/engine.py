@@ -1,0 +1,568 @@
+"""The hvd-serve inference engine: donated AOT prefill/decode executables
+over the paged KV cache, driven by the continuous-batching scheduler.
+
+Megakernel-style data plane (docs/inference.md): each serving phase is
+ONE compiled XLA program — page-table gather → cache-aware forward
+(:func:`..models.transformer.forward_step`) → scatter of the new KV
+entries back into the paged store — with the page arrays donated, so a
+decode iteration is a single dispatch whose working set updates in
+place.  Executables are built ahead of time (``jit(...).lower(...)
+.compile()``) and recorded in the PR-5 persistent-cache manifest under
+``variant: "serving"`` (ops/megakernel.py ``record_manifest_entry``):
+:meth:`InferenceEngine.warm_start` rebuilds every recorded executable
+at startup — against a warm ``HVD_TPU_COMPILE_CACHE_DIR`` the XLA
+compile is a disk-cache read — so a relaunched serving fleet reaches
+full token rate before its first request, and ``/healthz`` reports
+NOT_READY until it has.
+
+Bitwise contract (CI-gated by tests/test_serving.py and ``bench.py
+--mode serving``): a prefill of the prompt followed by N single-token
+decode iterations reproduces, bit for bit, the logits of the
+non-incremental :func:`..models.transformer.serving_forward` of the
+same tokens — greedy generation is therefore exactly reproducible
+across the static/continuous schedulers, batch compositions, slot
+assignments, and engine relaunches.  Two rules carry it: every token
+block is at least 2 wide (decode pads a discarded dummy column —
+XLA:CPU's single-row gemv accumulates differently from the gemm every
+other width uses), and comparisons are jit↔jit (the eager path fuses
+differently).
+
+Multi-host serving: rank 0 owns the scheduler and the HTTP front door;
+workers mirror its per-iteration plan (admissions, then sampled
+tokens/evictions) over the control plane's object collectives and run
+the identical executables — the same rank-0-decides/broadcast
+convention the checkpoint and elastic paths use.  Like every
+multi-process data-plane leg, this needs a jax build whose CPU backend
+executes np>1 collectives (CI), not the container's 0.4.37.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .. import telemetry as _telemetry
+from ..core.topology import MODEL_AXIS
+from ..models import transformer as _transformer
+from ..ops import megakernel as _megakernel
+from .kv_cache import PagedKVCache
+from .scheduler import ContinuousBatchingScheduler, Request
+
+_M_TTFT = _telemetry.histogram(
+    "serving.ttft_seconds", "seconds",
+    "time from submission to the first generated token")
+_M_TOKEN_LAT = _telemetry.histogram(
+    "serving.token_seconds", "seconds",
+    "per-token decode latency (one continuous-batching iteration)")
+_M_TOKENS = _telemetry.counter(
+    "serving.tokens_generated", "tokens sampled across all sequences")
+_M_PREFILLS = _telemetry.counter(
+    "serving.prefills", "prefill executions (one per admission)")
+_M_DECODES = _telemetry.counter(
+    "serving.decode_iterations", "batched decode iterations")
+_M_WARM = _telemetry.counter(
+    "serving.warm_starts", "serving executables AOT-rebuilt at startup")
+
+
+class InferenceEngine:
+    """Continuous-batching inference over one transformer LM.
+
+    ``params``/``cfg`` are the training-side parameter pytree and
+    :class:`~horovod_tpu.models.transformer.TransformerConfig`.  With a
+    ``mesh`` that has a ``model`` axis, the KV head axis and the
+    attention/FFN compute shard over it exactly like the training
+    forward (the ``parallel/tensor.py`` layout, via GSPMD).  All public
+    methods are meant to be driven from ONE thread (the serve loop);
+    ``submit`` alone is thread-safe (the scheduler's lock).
+    """
+
+    def __init__(self, params: Any, cfg, *, mesh=None, max_slots: int = 8,
+                 page_size: int = 16, capacity: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 model_axis: str = MODEL_AXIS) -> None:
+        cap = capacity if capacity is not None else cfg.max_seq_len
+        cap = min(cap, cfg.max_seq_len)
+        cap -= cap % page_size
+        if cap < 2 * page_size and cap < cfg.max_seq_len:
+            raise ValueError(
+                f"capacity {capacity} too small for page_size "
+                f"{page_size} (needs >= 2 pages' worth or "
+                f"max_seq_len)")
+        if cap < 2:
+            raise ValueError("KV capacity must be >= 2")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.eos_id = eos_id
+        self.max_slots = max_slots
+        self.cache = PagedKVCache(
+            cfg.n_layers, cfg.n_heads, cfg.d_model // cfg.n_heads,
+            max_slots, cap // page_size, page_size,
+            dtype=cfg.dtype, mesh=mesh, model_axis=model_axis)
+        self.capacity = self.cache.capacity
+        self.scheduler = ContinuousBatchingScheduler(max_slots,
+                                                     self.capacity)
+        if mesh is not None and self.cache.page_sharding() is not None:
+            rep = NamedSharding(mesh, P())
+            params = jax.tree_util.tree_map(
+                lambda x: jax.device_put(jnp.asarray(x), rep), params)
+        else:
+            params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.params = params
+        self._buckets = [b for b in
+                         (2 ** i for i in range(1, 31))
+                         if b <= self.capacity]
+        if self._buckets[-1] != self.capacity:
+            self._buckets.append(self.capacity)
+        self._exec: Dict[Tuple, Any] = {}
+        self._last_token = np.zeros((max_slots,), np.int32)
+        self._ready = False
+        self._drained = False
+
+    # -- readiness / warm start -------------------------------------------
+    @property
+    def ready(self) -> bool:
+        """True once :meth:`warm_start` completed — the ``/healthz``
+        readiness bit (NOT_READY before; the load-balancer keeps
+        traffic away until the executables exist)."""
+        return self._ready
+
+    def health(self) -> Tuple[bool, dict]:
+        """Exporter health contributor (exporter.register_health)."""
+        return self._ready, {
+            "ready": self._ready,
+            "queue_depth": self.scheduler.queue_depth(),
+            "batch_occupancy": self.scheduler.occupancy(),
+            "slots": self.max_slots,
+            "executables": len(self._exec),
+        }
+
+    def warm_start(self, directory: Optional[str] = None) -> int:
+        """Build the decode executable plus every serving executable the
+        persistent-cache manifest recorded for this model/mesh, then
+        mark the engine ready.  On a relaunch with a warm
+        ``HVD_TPU_COMPILE_CACHE_DIR`` the compiles are disk-cache
+        reads — the fleet serves at full token rate from the first
+        request.  Returns the number of manifest entries rebuilt."""
+        ident = self._manifest_identity()
+        warmed = 0
+        for entry in _megakernel.serving_entries(directory):
+            if any(entry.get(k) != ident[k]
+                   for k in ("model", "mesh", "slots", "page_size",
+                             "pages_per_slot")):
+                continue
+            try:
+                if entry.get("kind") == "decode":
+                    self._decode_exec()
+                elif entry.get("kind") == "prefill":
+                    b = int(entry.get("bucket") or 0)
+                    if b in self._buckets:
+                        self._prefill_exec(b)
+                    else:
+                        continue
+                else:
+                    continue
+                warmed += 1
+            except Exception:  # noqa: BLE001 — a stale entry must not
+                continue       # block startup; it just compiles lazily
+        self._decode_exec()  # readiness == "can decode", manifest or not
+        if warmed:
+            _M_WARM.inc(warmed)
+        self._ready = True
+        return warmed
+
+    # -- manifest ----------------------------------------------------------
+    def _mesh_key(self):
+        if self.mesh is not None:
+            return tuple(self.mesh.devices.flat)
+        return (jax.devices()[0],)
+
+    def _manifest_identity(self) -> dict:
+        return {
+            "variant": "serving",
+            "model": {
+                "vocab_size": self.cfg.vocab_size,
+                "d_model": self.cfg.d_model,
+                "n_heads": self.cfg.n_heads,
+                "n_layers": self.cfg.n_layers,
+                "d_ff": self.cfg.d_ff,
+                "max_seq_len": self.cfg.max_seq_len,
+                "dtype": jnp.dtype(self.cfg.dtype).name,
+            },
+            "slots": self.max_slots,
+            "page_size": self.cache.page_size,
+            "pages_per_slot": self.cache.pages_per_slot,
+            "mesh": _megakernel.mesh_fingerprint(self._mesh_key()),
+        }
+
+    def _record(self, kind: str, bucket: Optional[int]) -> None:
+        entry = dict(self._manifest_identity())
+        entry["kind"] = kind
+        entry["bucket"] = bucket
+        _megakernel.record_manifest_entry(entry)
+
+    # -- executables -------------------------------------------------------
+    def _aot(self, key: Tuple, fn, args: Tuple) -> Any:
+        """Compile ``fn`` for ``args``' shapes/shardings (donating the
+        page arrays at positions 1 and 2) and cache the executable."""
+        compiled = self._exec.get(key)
+        if compiled is not None:
+            return compiled
+        avals = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding), args)
+        jfn = jax.jit(fn, donate_argnums=(1, 2))
+        compiled = jfn.lower(*avals).compile()
+        self._exec[key] = compiled
+        self._record(key[0], key[1] if len(key) > 1 else None)
+        return compiled
+
+    def _rep(self, x) -> jnp.ndarray:
+        """Tiny control array → device, replicated under a mesh."""
+        a = jnp.asarray(x)
+        if self.mesh is not None and self.cache.page_sharding() is not None:
+            a = jax.device_put(a, NamedSharding(self.mesh, P()))
+        return a
+
+    def _decode_exec(self) -> Any:
+        cfg, cache, B = self.cfg, self.cache, self.max_slots
+        ps, pps, n_pages = (cache.page_size, cache.pages_per_slot,
+                            cache.n_pages)
+        L, H = cfg.n_layers, cfg.n_heads
+        hd = cfg.d_model // H
+
+        def kernel(params, k_pages, v_pages, table, lengths, tokens):
+            k_view = k_pages[:, table].reshape(L, B, pps * ps, H, hd)
+            v_view = v_pages[:, table].reshape(L, B, pps * ps, H, hd)
+            # Width-2 block: [token, dummy]; the dummy column keeps the
+            # gemms off XLA:CPU's bitwise-divergent single-row path and
+            # is never sampled nor scattered.
+            blk = jnp.stack([tokens, jnp.zeros_like(tokens)], axis=1)
+            logits, k_new, v_new = _transformer.forward_step(
+                params, blk, lengths, k_view, v_view, cfg)
+            pos = jnp.clip(lengths, 0, None)
+            page = table[jnp.arange(B), pos // ps]
+            flat = page * ps + pos % ps
+            kf = k_pages.reshape(L, n_pages * ps, H, hd)
+            vf = v_pages.reshape(L, n_pages * ps, H, hd)
+            kf = kf.at[:, flat].set(k_new[:, :, 0])
+            vf = vf.at[:, flat].set(v_new[:, :, 0])
+            return (logits[:, 0], kf.reshape(k_pages.shape),
+                    vf.reshape(v_pages.shape))
+
+        table, lengths = cache.device_tables()
+        args = (self.params, cache.k_pages, cache.v_pages, table,
+                lengths, self._rep(np.zeros((B,), np.int32)))
+        return self._aot(("decode",), kernel, args)
+
+    def _prefill_exec(self, bucket: int) -> Any:
+        cfg, cache = self.cfg, self.cache
+        ps, pps, n_pages = (cache.page_size, cache.pages_per_slot,
+                            cache.n_pages)
+        L, H = cfg.n_layers, cfg.n_heads
+        hd = cfg.d_model // H
+
+        def kernel(params, k_pages, v_pages, table_row, length, tokens):
+            k_view = k_pages[:, table_row].reshape(L, 1, pps * ps, H, hd)
+            v_view = v_pages[:, table_row].reshape(L, 1, pps * ps, H, hd)
+            logits, k_new, v_new = _transformer.forward_step(
+                params, tokens, jnp.zeros((1,), jnp.int32),
+                k_view, v_view, cfg)
+            i = jnp.arange(bucket)
+            page = table_row[0, i // ps]
+            flat = page * ps + i % ps  # pad positions land in trash
+            kf = k_pages.reshape(L, n_pages * ps, H, hd)
+            vf = v_pages.reshape(L, n_pages * ps, H, hd)
+            kf = kf.at[:, flat].set(k_new[:, 0])
+            vf = vf.at[:, flat].set(v_new[:, 0])
+            return (logits[0, length[0] - 1],
+                    kf.reshape(k_pages.shape), vf.reshape(v_pages.shape))
+
+        args = (self.params, cache.k_pages, cache.v_pages,
+                self._rep(np.zeros((1, pps), np.int32)),
+                self._rep(np.ones((1,), np.int32)),
+                self._rep(np.zeros((1, bucket), np.int32)))
+        return self._aot(("prefill", bucket), kernel, args)
+
+    def _bucket_for(self, n: int) -> int:
+        n = max(2, min(n, self.capacity))
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    # -- request surface ---------------------------------------------------
+    def submit(self, prompt: List[int], max_new_tokens: int = 32,
+               eos_id: Optional[int] = None, temperature: float = 0.0,
+               seed: int = 0, arrival: int = 0) -> Request:
+        req = Request(prompt=[int(t) for t in prompt],
+                      max_new_tokens=max_new_tokens,
+                      eos_id=self.eos_id if eos_id is None else eos_id,
+                      temperature=temperature, seed=seed,
+                      arrival=arrival)
+        req.t_submit = time.perf_counter()
+        return self.scheduler.submit(req)
+
+    def generate(self, prompt: List[int], max_new_tokens: int = 32,
+                 **kw) -> List[int]:
+        """Synchronous convenience: submit + drive to completion."""
+        req = self.submit(prompt, max_new_tokens, **kw)
+        self.run_until_idle()
+        return req.result(timeout=0)
+
+    def run_until_idle(self, max_iterations: int = 1_000_000) -> int:
+        """Drive :meth:`step` until queue and batch are empty; returns
+        iterations run."""
+        it = 0
+        while not self.scheduler.idle() and it < max_iterations:
+            self.step()
+            it += 1
+        return it
+
+    # -- the continuous-batching iteration --------------------------------
+    def step(self, now: Optional[int] = None, admit: bool = True) -> bool:
+        """ONE iteration: admit into free slots (prefill each new
+        sequence and sample its first token from the prefill logits —
+        TTFT pays no decode-batching delay), then one batched decode
+        over every active slot — sequences finish and admit mid-stream,
+        no batch boundary.  ``now`` gates admission on logical arrival
+        stamps (trace replay); None admits anything queued.
+        ``admit=False`` skips admission entirely — that is the whole
+        difference between this engine and a static batcher, and
+        exactly how ``bench.py --mode serving`` builds its baseline
+        (admit only at batch boundaries).  Returns whether any work
+        ran.
+
+        Multi-host: rank 0 (the only rank with a scheduler) broadcasts
+        the admission plan, then post-prefill state, then the sampled
+        tokens, so :meth:`follow` on worker ranks mirrors the cache and
+        runs the identical executables in the same order."""
+        mp = self._multiprocess()
+        admitted = self.scheduler.admit(now) if admit else []
+        if mp:
+            self._bcast({"stop": False,
+                         "admit": [(slot, list(req.prompt))
+                                   for slot, req in admitted]})
+        for slot, req in admitted:
+            self._prefill_and_sample(slot, req)
+        active = self.scheduler.active()
+        if mp:
+            # Post-prefill sync: first sampled tokens + which slots
+            # survived into the decode batch (a max_new_tokens=1
+            # admission can finish at prefill).
+            self._bcast({
+                "last": {s: int(self._last_token[s])
+                         for s, _ in active},
+                "decode": [s for s, _ in active],
+                "evict": [s for s, _ in admitted
+                          if self.cache.length(s) < 0]})
+        if active:
+            self._decode_iteration(active)
+        return bool(admitted or active)
+
+    def _sample(self, req: Request, logits: np.ndarray) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        z = (logits - logits.max()) / req.temperature
+        p = np.exp(z)
+        p /= p.sum()
+        # Keyed on request-local state only (seed + decode position),
+        # never on scheduler history (rid/slot), so a sampled rollout
+        # reproduces across engines, relaunches, and batch mixes.
+        rng = np.random.default_rng(
+            (req.seed, len(req.prefix) + len(req.generated)))
+        return int(rng.choice(len(p), p=p))
+
+    def _feed(self, slot: int, req: Request, token: int) -> None:
+        if not req.generated:
+            req.t_first_token = time.perf_counter()
+            _M_TTFT.observe(req.t_first_token - req.t_submit)
+        _M_TOKENS.inc()
+        reason = self.scheduler.feed(slot, token)
+        if reason is not None:
+            req.t_done = time.perf_counter()
+            self.cache.free_slot(slot)
+        else:
+            self._last_token[slot] = token
+
+    def _prefill(self, slot: int, req: Request,
+                 prompt: Optional[List[int]] = None) -> np.ndarray:
+        prompt = list(req.prompt) if prompt is None else prompt
+        n = len(prompt)
+        self.cache.begin_slot(slot, n)
+        bucket = self._bucket_for(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = prompt
+        compiled = self._prefill_exec(bucket)
+        last, kp, vp = compiled(
+            self.params, self.cache.k_pages, self.cache.v_pages,
+            self._rep(self.cache._table[slot:slot + 1]),
+            self._rep(np.asarray([n], np.int32)), self._rep(tokens))
+        self.cache.replace_pages(kp, vp)
+        _M_PREFILLS.inc()
+        return np.asarray(last)
+
+    def _decode_iteration(self, active) -> np.ndarray:
+        t0 = time.perf_counter()
+        for slot, _ in active:
+            self.cache.ensure(slot, self.cache.length(slot))
+        table, lengths = self.cache.device_tables()
+        tokens = np.zeros((self.max_slots,), np.int32)
+        for slot, _ in active:
+            tokens[slot] = self._last_token[slot]
+        compiled = self._decode_exec()
+        logits, kp, vp = compiled(
+            self.params, self.cache.k_pages, self.cache.v_pages,
+            table, lengths, self._rep(tokens))
+        self.cache.replace_pages(kp, vp)
+        logits_np = np.asarray(logits)
+        fed = {}
+        evicted = []
+        for slot, req in active:
+            self.cache.advance(slot)  # the input token's KV landed
+            token = self._sample(req, logits_np[slot])
+            fed[slot] = token
+            self._feed(slot, req, token)
+            if self.cache.length(slot) < 0:
+                evicted.append(slot)
+        if self._multiprocess():
+            self._bcast({"tokens": fed, "evict": evicted})
+        _M_DECODES.inc()
+        _M_TOKEN_LAT.observe(time.perf_counter() - t0)
+        return logits_np
+
+    def _prefill_and_sample(self, slot: int, req: Request) -> None:
+        last = self._prefill(slot, req)
+        self._feed(slot, req, self._sample(req, last))
+
+    # -- multi-host mirroring ---------------------------------------------
+    def _multiprocess(self) -> bool:
+        try:
+            from ..core import state as _state
+
+            return (_state.is_initialized()
+                    and _state.global_state().multiprocess
+                    and _state.global_state().process_count > 1)
+        except Exception:  # noqa: BLE001 — serving works without init
+            return False
+
+    def _bcast(self, obj):
+        from ..ops.objects import broadcast_object
+
+        return broadcast_object(obj, root_rank=0, name="hvd-serve-plan")
+
+    def follow(self) -> bool:
+        """Worker-rank iteration mirroring ONE rank-0 :meth:`step`:
+        receive the admission plan (prefill those slots), the
+        post-prefill sync (first tokens + decode batch + early
+        evictions), run the identical decode executable when rank 0
+        does, then apply its sampled tokens/evictions to the local
+        cache mirror.  Returns False when rank 0 announced shutdown
+        (:meth:`stop_followers`).  Worker ranks have no scheduler —
+        rank 0 decides, the data plane stays SPMD."""
+        plan = self._bcast(None)
+        if plan.get("stop"):
+            return False
+        for slot, prompt in plan.get("admit", ()):
+            self._prefill(slot, Request(prompt=list(prompt)),
+                          prompt=list(prompt))
+        sync = self._bcast(None)
+        for slot, token in sync.get("last", {}).items():
+            self._last_token[int(slot)] = int(token)
+        for slot in sync.get("evict", ()):
+            if self.cache.length(int(slot)) >= 0:
+                self.cache.free_slot(int(slot))
+        decode = [int(s) for s in sync.get("decode", ())]
+        if decode:
+            for slot in decode:
+                self.cache.ensure(slot, self.cache.length(slot))
+            table, lengths = self.cache.device_tables()
+            tokens = np.zeros((self.max_slots,), np.int32)
+            for slot in decode:
+                tokens[slot] = self._last_token[slot]
+            compiled = self._decode_exec()
+            _, kp, vp = compiled(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                table, lengths, self._rep(tokens))
+            self.cache.replace_pages(kp, vp)
+            fed = self._bcast(None)
+            for slot in decode:
+                self.cache.advance(slot)
+            for slot, token in fed.get("tokens", {}).items():
+                self._last_token[int(slot)] = int(token)
+            for slot in fed.get("evict", ()):
+                if self.cache.length(int(slot)) >= 0:
+                    self.cache.free_slot(int(slot))
+        return True
+
+    def stop_followers(self) -> None:
+        if self._multiprocess():
+            self._bcast({"stop": True})
+
+    # -- elastic drain / resume -------------------------------------------
+    def export_requests(self) -> List[dict]:
+        """Queued + in-flight work as resubmittable dicts: in-flight
+        sequences become continuations (prompt extended by what they
+        generated so far; the bitwise prefill≡decode contract makes
+        the continuation reproduce the uninterrupted greedy rollout).
+        Does not stop the engine — pair with :meth:`drain` for the
+        elastic resize path (:class:`horovod_tpu.elastic.ServingState`).
+        """
+        out = []
+        for _, req in self.scheduler.active():
+            out.append({
+                "prompt": list(req.prompt) + list(req.generated),
+                "generated_prefix": list(req.prefix)
+                + list(req.generated),
+                "max_new_tokens": req.max_new_tokens - len(req.generated),
+                "eos_id": req.eos_id, "temperature": req.temperature,
+                "seed": req.seed,
+            })
+        for req in self.scheduler.pending():
+            out.append({
+                "prompt": list(req.prompt),
+                "generated_prefix": list(req.prefix),
+                "max_new_tokens": req.max_new_tokens,
+                "eos_id": req.eos_id, "temperature": req.temperature,
+                "seed": req.seed,
+            })
+        return out
+
+    def drain(self) -> List[dict]:
+        """Serving-fleet resize, step 1: capture every queued and
+        in-flight request as a continuation, then evict everything and
+        stop admission.  The returned list (same format as
+        :meth:`export_requests`) is what the elastic commit persists;
+        a relaunched engine resubmits it via :meth:`import_requests`."""
+        exported = self.export_requests()
+        self.scheduler.drain()
+        for slot in range(self.max_slots):
+            if self.cache.length(slot) >= 0:
+                self.cache.free_slot(slot)
+        self._drained = True
+        return exported
+
+    def import_requests(self, exported: List[dict]) -> List[Request]:
+        """Resubmit a drained export (relaunch path).  Continuation
+        requests keep their already-generated prefix, so callers see
+        uninterrupted results."""
+        if self._drained:
+            self.scheduler.resume()
+            self._drained = False
+        out = []
+        for d in exported:
+            if d.get("max_new_tokens", 0) <= 0:
+                continue
+            req = self.submit(
+                d["prompt"], max_new_tokens=d["max_new_tokens"],
+                eos_id=d.get("eos_id"),
+                temperature=d.get("temperature", 0.0),
+                seed=d.get("seed", 0))
+            req.prefix = list(d.get("generated_prefix", []))
+            out.append(req)
+        return out

@@ -1,0 +1,163 @@
+"""Paged KV cache: fixed-size pages, free-list recycling, TP sharding.
+
+Storage is two device arrays per engine —
+``k_pages``/``v_pages: [n_layers, n_pages, page_size, n_heads,
+head_dim]`` — plus a HOST page table (``[max_slots, pages_per_slot]``
+int32, numpy) mapping each decode slot's logical positions onto
+physical pages.  Pages are allocated on demand as a sequence grows and
+recycled through a free list the moment the scheduler evicts it, so
+slot reuse never copies or zeroes KV data: the next sequence simply
+maps fresh pages and the old values become unreachable (masked by
+:func:`..models.transformer.cache_attention` long before they are
+overwritten).
+
+Page 0 is the reserved *trash* page: unmapped table entries point at
+it, so the executables' scatters of padded/inactive positions land
+somewhere harmless instead of needing per-position predication.
+Nothing ever reads trash through an unmasked attention row (entry
+``j`` is only unmasked for ``j <= q_pos < length``, and every position
+``< length`` is mapped by construction); written values are finite, so
+masked rows contribute exact zeros regardless of trash content — the
+bitwise contract does not depend on it.
+
+Tensor parallelism: the head axis is sharded over the mesh's ``model``
+axis with a ``NamedSharding`` — the SAME partition
+``parallel/tensor.py`` gives the training attention (heads
+column-parallel), so a model served on its training mesh reuses the
+training layout and GSPMD partitions prefill/decode along heads with
+no code change here.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..core.topology import MODEL_AXIS
+
+
+class PagedKVCache:
+    """The paged store for one :class:`~horovod_tpu.serving.engine.
+    InferenceEngine`.  Not thread-safe on its own — the engine's
+    iteration loop is the only writer (the scheduler lock serializes
+    everything upstream of it)."""
+
+    def __init__(self, n_layers: int, n_heads: int, head_dim: int,
+                 max_slots: int, pages_per_slot: int, page_size: int,
+                 dtype=jnp.float32, mesh=None,
+                 model_axis: str = MODEL_AXIS) -> None:
+        if pages_per_slot < 1 or page_size < 1:
+            raise ValueError("pages_per_slot and page_size must be >= 1")
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.head_dim = head_dim
+        self.max_slots = max_slots
+        self.pages_per_slot = pages_per_slot
+        self.page_size = page_size
+        self.capacity = pages_per_slot * page_size  # per sequence
+        self.n_pages = 1 + max_slots * pages_per_slot  # +1: trash page
+        self.dtype = dtype
+        self.mesh = mesh
+        self.model_axis = model_axis
+
+        shape = (n_layers, self.n_pages, page_size, n_heads, head_dim)
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+        sh = self.page_sharding()
+        if sh is not None:
+            k = jax.device_put(k, sh)
+            v = jax.device_put(v, sh)
+        self.k_pages = k
+        self.v_pages = v
+
+        self._free: List[int] = list(range(1, self.n_pages))
+        self._table = np.zeros((max_slots, pages_per_slot), np.int32)
+        self._lengths = np.full((max_slots,), -1, np.int32)
+
+    # -- sharding ----------------------------------------------------------
+    def page_sharding(self) -> Optional[NamedSharding]:
+        """NamedSharding for the page arrays (heads over the model
+        axis), or None when the mesh has no model axis to shard over —
+        the training partition, reused for serving."""
+        if self.mesh is None or self.model_axis not in getattr(
+                self.mesh, "axis_names", ()):
+            return None
+        tp = self.mesh.shape[self.model_axis]
+        if tp <= 1:
+            return None
+        if self.n_heads % tp != 0:
+            raise ValueError(
+                f"tensor-parallel degree {tp} must divide n_heads "
+                f"({self.n_heads}) to shard the KV head axis")
+        return NamedSharding(self.mesh,
+                             P(None, None, None, self.model_axis, None))
+
+    # -- page management ---------------------------------------------------
+    def begin_slot(self, slot: int, n_tokens: int) -> None:
+        """Map pages for a freshly admitted sequence's first
+        ``n_tokens`` positions (the prompt) and set its length."""
+        if self._lengths[slot] >= 0:
+            raise ValueError(f"slot {slot} already active")
+        self._table[slot] = 0
+        self._lengths[slot] = 0
+        self.ensure(slot, n_tokens - 1)
+        self._lengths[slot] = n_tokens
+
+    def ensure(self, slot: int, pos: int) -> None:
+        """Map pages so position ``pos`` of ``slot`` is writable."""
+        if pos >= self.capacity:
+            raise ValueError(
+                f"position {pos} exceeds per-slot capacity "
+                f"{self.capacity}")
+        for p in range(pos // self.page_size + 1):
+            if self._table[slot, p] == 0:
+                if not self._free:
+                    raise RuntimeError(
+                        "paged KV cache out of pages (free list empty) "
+                        "— sizing guarantees this cannot happen while "
+                        "every slot stays within pages_per_slot")
+                self._table[slot, p] = self._free.pop(0)
+
+    def advance(self, slot: int) -> int:
+        """One decoded token was written at the current length; map the
+        page first via :meth:`ensure`.  Returns the new length."""
+        self._lengths[slot] += 1
+        return int(self._lengths[slot])
+
+    def free_slot(self, slot: int) -> None:
+        """Evict: recycle the slot's pages onto the free list."""
+        for p in range(self.pages_per_slot):
+            page = int(self._table[slot, p])
+            if page != 0:
+                self._free.append(page)
+        self._table[slot] = 0
+        self._lengths[slot] = -1
+
+    def length(self, slot: int) -> int:
+        return int(self._lengths[slot])
+
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    # -- device views ------------------------------------------------------
+    def device_tables(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(page_table, lengths) as device arrays for the executables
+        (replicated under a mesh — they are tiny)."""
+        table = jnp.asarray(self._table)
+        lengths = jnp.asarray(self._lengths)
+        if self.mesh is not None and self.page_sharding() is not None:
+            rep = NamedSharding(self.mesh, P())
+            table = jax.device_put(table, rep)
+            lengths = jax.device_put(lengths, rep)
+        return table, lengths
+
+    def replace_pages(self, k_pages, v_pages) -> None:
+        """Install the executables' donated-output page arrays (the old
+        references were consumed by the dispatch)."""
+        self.k_pages = k_pages
+        self.v_pages = v_pages

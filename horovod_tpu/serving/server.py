@@ -1,0 +1,210 @@
+"""The hvd-serve HTTP front door: ``/generate`` on the telemetry
+exporter's route registry.
+
+One listener per process (docs/inference.md "The load-balancer
+contract"): serving does NOT bind its own port — it registers routes on
+the exporter's process-global :class:`~horovod_tpu.telemetry.exporter.
+RouteRegistry`, so ``/generate``, ``/metrics`` and ``/healthz`` share
+the server ``hvd.init()`` started on ``HVD_TPU_METRICS_PORT`` (or one
+the :class:`LMServer` starts itself when none is running).  ``/healthz``
+reports ``NOT_READY`` (HTTP 503) until the engine's ``warm_start``
+completes, then ``ok`` with queue depth and batch occupancy — exactly
+what a load balancer needs to keep traffic off a still-compiling
+relaunch and to spread it by load afterwards.
+
+``POST /generate`` accepts JSON with either ``tokens`` (a list of ids)
+or ``text`` (encoded with the checkpoint's tokenizer — the byte
+tokenizer maps UTF-8 bytes to ids, so any ``vocab_size >= 256`` model
+serves raw text), plus optional ``max_tokens``, ``temperature``,
+``seed``.  The handler blocks until the scheduler evicts the sequence
+and returns the completion with TTFT and per-token latency for that
+request.  Handlers run on the exporter's per-request threads; the
+engine loop runs on the server's own thread — the scheduler lock is the
+only shared state.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional, Tuple
+
+from .. import telemetry as _telemetry
+from ..telemetry import exporter as _exporter
+from .engine import InferenceEngine
+
+HEALTH_KEY = "serving"
+GENERATE_PATH = "/generate"
+
+
+def encode_text(text: str, vocab_size: int) -> list:
+    """Byte tokenizer: UTF-8 bytes as token ids (needs vocab >= 256)."""
+    if vocab_size < 256:
+        raise ValueError(
+            f"the byte tokenizer needs vocab_size >= 256, got "
+            f"{vocab_size}; send token ids instead")
+    return list(text.encode("utf-8"))
+
+
+def decode_tokens(tokens: list, vocab_size: int) -> Optional[str]:
+    """Inverse byte tokenizer (None when ids fall outside byte range)."""
+    if vocab_size < 256 or any(not 0 <= t < 256 for t in tokens):
+        return None
+    return bytes(tokens).decode("utf-8", errors="replace")
+
+
+class LMServer:
+    """Engine loop thread + route registration.
+
+    ``start()`` warm-starts the engine (readiness flips the shared
+    ``/healthz``), spawns the continuous-batching loop, and registers
+    ``/generate``.  When no exporter is live (``hvd.init()`` without
+    ``HVD_TPU_METRICS_PORT``, or no init at all) and ``port`` is given,
+    it starts one — same registry, so the endpoints are identical
+    either way."""
+
+    def __init__(self, engine: InferenceEngine,
+                 port: Optional[int] = None,
+                 host: str = "127.0.0.1") -> None:
+        self.engine = engine
+        self._port = port
+        self._host = host
+        self._own_exporter: Optional[_exporter.MetricsExporter] = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def port(self) -> Optional[int]:
+        if self._own_exporter is not None:
+            return self._own_exporter.port
+        exp = self._shared_exporter()
+        return exp.port if exp is not None else None
+
+    def _shared_exporter(self):
+        try:
+            from ..core import state as _state
+
+            return _state.global_state().metrics_exporter
+        except Exception:  # noqa: BLE001 — serving works without init
+            return None
+
+    def start(self, warm_start_dir: Optional[str] = None) -> "LMServer":
+        routes = _exporter.routes()
+        # Readiness first: a probing load balancer sees NOT_READY from
+        # the instant the process answers, not a 404 window.
+        routes.register_health(HEALTH_KEY, self.engine.health)
+        self.engine.warm_start(warm_start_dir)
+        routes.register(GENERATE_PATH, self._handle_generate,
+                        methods=("POST",))
+        if self._shared_exporter() is None and self._port is not None:
+            self._own_exporter = _exporter.start_exporter(
+                _telemetry.registry(), self._port, host=self._host)
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-serve-loop", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        self.engine.stop_followers()
+        routes = _exporter.routes()
+        routes.unregister(GENERATE_PATH)
+        routes.unregister_health(HEALTH_KEY)
+        if self._own_exporter is not None:
+            self._own_exporter.close()
+            self._own_exporter = None
+
+    def __enter__(self) -> "LMServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- the serve loop ----------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self.engine.scheduler.idle():
+                # Park until a submission wakes us; short timeout so a
+                # racing submit-after-idle-check is picked up anyway.
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            try:
+                self.engine.step()
+            except Exception as e:  # noqa: BLE001 — the loop must
+                # survive one bad batch; the flight recorder keeps the
+                # forensics, every caught-up request fails FAST (not at
+                # its HTTP timeout), and the engine drain frees the KV
+                # slots/pages so the next request serves normally.
+                _telemetry.exception_event("serve-loop",
+                                           f"{type(e).__name__}: {e}")
+                pending = self.engine.scheduler.pending()
+                active = [r for _, r in self.engine.scheduler.active()]
+                self.engine.drain()
+                self.engine.import_requests([])  # re-open admission
+                for req in active + pending:
+                    req.finish_reason = "error"
+                    req.done.set()
+
+    # -- /generate ---------------------------------------------------------
+    def _handle_generate(self, query: str,
+                         body: bytes) -> Tuple[int, bytes, str]:
+        try:
+            payload = json.loads(body.decode() or "{}")
+        except ValueError:
+            return (400, b'{"error": "invalid JSON"}\n',
+                    "application/json")
+        vocab = self.engine.cfg.vocab_size
+        tokens = payload.get("tokens")
+        if tokens is None and "text" in payload:
+            try:
+                tokens = encode_text(payload["text"], vocab)
+            except ValueError as e:
+                return (400, json.dumps({"error": str(e)}).encode(),
+                        "application/json")
+        if not tokens:
+            return (400, b'{"error": "need tokens or text"}\n',
+                    "application/json")
+        if any(not 0 <= int(t) < vocab for t in tokens):
+            return (400, json.dumps(
+                {"error": f"token ids must be in [0, {vocab})"}).encode(),
+                "application/json")
+        try:
+            req = self.engine.submit(
+                [int(t) for t in tokens],
+                max_new_tokens=int(payload.get("max_tokens", 32)),
+                temperature=float(payload.get("temperature", 0.0)),
+                seed=int(payload.get("seed", 0)))
+        except (ValueError, RuntimeError) as e:
+            return (400, json.dumps({"error": str(e)}).encode(),
+                    "application/json")
+        self._wake.set()
+        timeout = float(payload.get("timeout", 120.0))
+        t0 = time.perf_counter()
+        try:
+            out = req.result(timeout=timeout)
+        except TimeoutError:
+            return (504, json.dumps(
+                {"error": "generation timed out", "rid": req.rid}
+            ).encode(), "application/json")
+        total = time.perf_counter() - t0
+        resp = {
+            "rid": req.rid,
+            "tokens": out,
+            "finish_reason": req.finish_reason,
+            "ttft_ms": round((req.t_first_token - req.t_submit) * 1e3, 3)
+            if req.t_first_token else None,
+            "total_ms": round(total * 1e3, 3),
+            "tokens_per_sec": round(len(out) / total, 1) if total else None,
+        }
+        text = decode_tokens(out, vocab)
+        if text is not None:
+            resp["text"] = text
+        return (200, (json.dumps(resp) + "\n").encode(),
+                "application/json")

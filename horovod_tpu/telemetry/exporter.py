@@ -1,4 +1,5 @@
-"""Optional HTTP exporter: Prometheus text / JSON ``/metrics`` + ``/healthz``.
+"""HTTP exporter: a route registry serving ``/metrics`` + ``/healthz``
+plus any routes other subsystems register (hvd-serve's ``/generate``).
 
 Off by default.  ``HVD_TPU_METRICS_PORT=<port>`` makes ``hvd.init()``
 start one on the rank-0 controller (``HVD_TPU_METRICS_ALL_RANKS=1`` for
@@ -6,15 +7,27 @@ every rank); ``hvd.shutdown()`` stops it.  Tests and embedders can run
 one directly via :func:`start_exporter` (port 0 picks an ephemeral
 port, exposed as ``exporter.port``).
 
+There is ONE process-global :class:`RouteRegistry` (:func:`routes`):
+every exporter instance serves it, so a subsystem that needs an HTTP
+surface — serving's ``/generate`` front door, a probe endpoint —
+registers a route instead of binding a second listener that would fight
+the exporter over ``HVD_TPU_METRICS_PORT``.  Routes registered before
+or after the server starts are equally visible (dispatch reads the
+registry per request).
+
 Endpoints:
   GET /metrics         Prometheus text exposition (``hvd_`` prefix,
                        histograms as cumulative ``_bucket{le=...}``)
   GET /metrics?format=json   the raw ``hvd.metrics()`` snapshot
-  GET /healthz         ``{"status": "ok", "rank": r, "initialized": b}``
+  GET /healthz         ``{"status": "ok"|"NOT_READY", ...}`` — 200 when
+                       every registered health contributor reports
+                       ready, 503 otherwise (the load-balancer
+                       contract: hvd-serve contributes NOT_READY until
+                       its ``warm_start`` completes, docs/inference.md)
 
 The server thread only ever *reads* registry snapshots — it takes no
-runtime lock beyond the registry's own leaf, so a wedged control plane
-cannot wedge the health endpoint (that is the point of it).
+runtime lock beyond the registry's own leaves, so a wedged control
+plane cannot wedge the health endpoint (that is the point of it).
 """
 
 from __future__ import annotations
@@ -22,11 +35,19 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
 
+from ..analysis import lockorder as _lockorder
 from .registry import MetricsRegistry
 
 _PROM_HELP_TYPES = {"counter": "counter", "gauge": "gauge",
                     "histogram": "histogram"}
+
+# A route handler: (query_string, request_body) -> (status, body, ctype).
+RouteHandler = Callable[[str, bytes], Tuple[int, bytes, str]]
+# A health contributor: () -> (ready, payload_dict) — payload is merged
+# into the /healthz JSON under the contributor's name.
+HealthContributor = Callable[[], Tuple[bool, dict]]
 
 
 def prometheus_name(name: str) -> str:
@@ -57,27 +78,99 @@ def prometheus_text(snapshot: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
-def _health_payload() -> dict:
-    rank = None
-    initialized = False
-    try:
-        from ..core import state as _state
+class RouteRegistry:
+    """Path → handler table shared by every exporter instance.
 
-        st = _state.global_state()
-        initialized = bool(st.initialized)
-        if initialized:
-            rank = st.process_index
-    except Exception:  # noqa: BLE001 — health must answer regardless
-        pass
-    return {"status": "ok", "rank": rank, "initialized": initialized}
+    ``register``/``unregister`` may run from any thread at any time
+    relative to the server; dispatch takes a locked snapshot per
+    request.  The lock is a leaf on the hvd-analyze lock-order graph —
+    handlers run OUTSIDE it, so a slow handler (serving's blocking
+    ``/generate``) never wedges registration or other routes."""
+
+    def __init__(self) -> None:
+        self._lock = _lockorder.make_lock("exporter.RouteRegistry._lock")
+        self._routes: Dict[Tuple[str, str], RouteHandler] = {}
+        # guarded_by: _lock
+        self._health: Dict[str, HealthContributor] = {}  # guarded_by: _lock
+
+    def register(self, path: str, handler: RouteHandler,
+                 methods: Tuple[str, ...] = ("GET",)) -> None:
+        """Bind ``handler`` to ``path`` for ``methods`` (replaces any
+        previous binding — re-init idempotency)."""
+        with self._lock:
+            for m in methods:
+                self._routes[(m.upper(), path)] = handler
+
+    def unregister(self, path: str) -> None:
+        with self._lock:
+            for key in [k for k in self._routes if k[1] == path]:
+                del self._routes[key]
+
+    def register_health(self, name: str,
+                        contributor: HealthContributor) -> None:
+        """Add a readiness contributor to ``/healthz`` (keyed — a
+        re-registration replaces the previous instance)."""
+        with self._lock:
+            self._health[name] = contributor
+
+    def unregister_health(self, name: str) -> None:
+        with self._lock:
+            self._health.pop(name, None)
+
+    def lookup(self, method: str, path: str) -> Optional[RouteHandler]:
+        with self._lock:
+            return self._routes.get((method.upper(), path))
+
+    def paths(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted({p for _, p in self._routes}))
+
+    def health_payload(self) -> Tuple[int, dict]:
+        """(status_code, payload): 200/"ok" when every contributor is
+        ready, 503/"NOT_READY" otherwise — the load-balancer contract."""
+        rank = None
+        initialized = False
+        try:
+            from ..core import state as _state
+
+            st = _state.global_state()
+            initialized = bool(st.initialized)
+            if initialized:
+                rank = st.process_index
+        except Exception:  # noqa: BLE001 — health must answer regardless
+            pass
+        with self._lock:
+            contributors = dict(self._health)
+        payload = {"rank": rank, "initialized": initialized}
+        ready = True
+        for name, fn in contributors.items():
+            try:
+                ok, detail = fn()
+            except Exception as e:  # noqa: BLE001 — a broken
+                ok, detail = False, {"error": str(e)}  # contributor is
+                # a NOT_READY, not a 500
+            ready = ready and bool(ok)
+            payload[name] = detail
+        payload["status"] = "ok" if ready else "NOT_READY"
+        return (200 if ready else 503), payload
+
+
+_routes = RouteRegistry()
+
+
+def routes() -> RouteRegistry:
+    """The process-global route registry every exporter serves."""
+    return _routes
 
 
 class MetricsExporter:
-    """A daemon-threaded HTTP server bound to one registry."""
+    """A daemon-threaded HTTP server bound to one metrics registry and
+    the process-global route registry."""
 
     def __init__(self, registry: MetricsRegistry, port: int,
                  host: str = "0.0.0.0") -> None:
         self.registry = registry
+        self.routes = _routes
         exporter = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -92,12 +185,15 @@ class MetricsExporter:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def do_GET(self) -> None:  # noqa: N802 — http.server API
+            def _dispatch(self, method: str, body: bytes) -> None:
                 path, _, query = self.path.partition("?")
                 if path == "/healthz":
-                    self._reply(200, json.dumps(
-                        _health_payload()).encode(), "application/json")
-                elif path in ("/metrics", "/metrics.json"):
+                    code, payload = exporter.routes.health_payload()
+                    self._reply(code, json.dumps(payload).encode(),
+                                "application/json")
+                    return
+                if method == "GET" and path in ("/metrics",
+                                                "/metrics.json"):
                     snap = exporter.registry.snapshot()
                     if path.endswith(".json") or "format=json" in query:
                         self._reply(200, json.dumps(snap).encode(),
@@ -106,8 +202,27 @@ class MetricsExporter:
                         self._reply(
                             200, prometheus_text(snap).encode(),
                             "text/plain; version=0.0.4")
-                else:
+                    return
+                handler = exporter.routes.lookup(method, path)
+                if handler is None:
                     self._reply(404, b"not found\n", "text/plain")
+                    return
+                try:
+                    code, out, ctype = handler(query, body)
+                except Exception as e:  # noqa: BLE001 — one bad request
+                    # must not kill the server thread
+                    self._reply(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        "application/json")
+                    return
+                self._reply(code, out, ctype)
+
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                self._dispatch("GET", b"")
+
+            def do_POST(self) -> None:  # noqa: N802 — http.server API
+                n = int(self.headers.get("Content-Length") or 0)
+                self._dispatch("POST", self.rfile.read(n) if n else b"")
 
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.daemon_threads = True

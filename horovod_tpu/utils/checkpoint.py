@@ -277,6 +277,80 @@ def restore_checkpoint(path: str, target: Any, broadcast: bool = True) -> Any:
     return tree
 
 
+# -- serving checkpoints (hvd-serve, docs/inference.md) --------------------
+
+SERVING_PARAMS_FILE = "params.msgpack"
+SERVING_META_FILE = "serving.json"
+
+
+def save_serving_checkpoint(directory: str, params: Any, cfg: Any,
+                            tokenizer: str = "byte",
+                            extra: Optional[dict] = None,
+                            block: bool = False) -> CheckpointWrite:
+    """Export a serving-ready checkpoint: the parameter pytree (flax
+    msgpack, via the background writer) plus a ``serving.json`` carrying
+    the model config and tokenizer metadata, so
+    ``examples/serve_lm.py`` / :func:`load_serving_checkpoint` can
+    build an :class:`~horovod_tpu.serving.engine.InferenceEngine` with
+    no knowledge of the training script.  Rank-0 only, like every save
+    (``examples/transformer_lm.py --export`` rides this)."""
+    import json
+
+    import jax.numpy as jnp
+
+    if _state.is_initialized() and not _is_saving_process():
+        return CheckpointWrite(None, performed=False)
+    os.makedirs(directory, exist_ok=True)
+    handle = write_tree_async(
+        os.path.join(directory, SERVING_PARAMS_FILE),
+        _host_snapshot(params))
+    meta = {
+        "format": "hvd-serving-checkpoint-v1",
+        "model": {
+            "vocab_size": cfg.vocab_size, "d_model": cfg.d_model,
+            "n_heads": cfg.n_heads, "n_layers": cfg.n_layers,
+            "d_ff": cfg.d_ff, "max_seq_len": cfg.max_seq_len,
+            "num_experts": cfg.num_experts,
+            "dtype": jnp.dtype(cfg.dtype).name,
+        },
+        "tokenizer": {"kind": tokenizer},
+        "extra": extra or {},
+    }
+    _write_bytes(os.path.join(directory, SERVING_META_FILE),
+                 json.dumps(meta, indent=1).encode())
+    if block:
+        handle.wait()
+    return handle
+
+
+def load_serving_checkpoint(directory: str):
+    """Load a :func:`save_serving_checkpoint` export.  Returns
+    ``(params, cfg, meta)`` — ``cfg`` a reconstructed
+    :class:`~horovod_tpu.models.transformer.TransformerConfig`, ``meta``
+    the raw ``serving.json`` dict (tokenizer kind, extras)."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    from flax import serialization
+
+    from ..models.transformer import TransformerConfig, init_transformer
+
+    with open(os.path.join(directory, SERVING_META_FILE)) as f:
+        meta = json.load(f)
+    m = meta["model"]
+    cfg = TransformerConfig(
+        vocab_size=int(m["vocab_size"]), d_model=int(m["d_model"]),
+        n_heads=int(m["n_heads"]), n_layers=int(m["n_layers"]),
+        d_ff=int(m["d_ff"]), max_seq_len=int(m["max_seq_len"]),
+        num_experts=int(m.get("num_experts", 0)),
+        dtype=jnp.dtype(m.get("dtype", "float32")))
+    template = init_transformer(jax.random.PRNGKey(0), cfg)
+    with open(os.path.join(directory, SERVING_PARAMS_FILE), "rb") as f:
+        params = serialization.from_bytes(template, f.read())
+    return params, cfg, meta
+
+
 def resume_epoch(path: str) -> int:
     """Determine the epoch to resume from and agree on it across replicas —
     the reference broadcasts this scalar explicitly

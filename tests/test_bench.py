@@ -153,6 +153,41 @@ def test_bench_input_mode_contract_and_identity():
     assert tel["batches_staged"] and tel["batches_staged"] > 0
 
 
+def test_bench_serving_mode_contract_and_determinism():
+    """`--mode serving` (this round): the hvd-serve microbench emits one
+    contract JSON line and must clear BOTH deterministic gates: the
+    continuous and static schedulers produce identical completions
+    (batch-composition invariance), and the engine rollout is bitwise-
+    equal to the non-incremental forward.  The ≥ 1.5x tokens/sec gate
+    lives in the CI `serving-bench` job; here only a loaded-box-safe
+    floor is asserted."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "serving"],
+        env=dict(os.environ), cwd=REPO, capture_output=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    lines = [ln for ln in proc.stdout.decode().splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1, proc.stdout.decode()
+    payload = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "continuous",
+                "static", "speedup", "results_identical",
+                "bitwise_identical"):
+        assert key in payload, payload
+    assert payload["metric"] == "serving_tokens_per_sec"
+    assert payload["results_identical"] is True, payload
+    assert payload["bitwise_identical"] is True, payload
+    for leg in ("continuous", "static"):
+        assert payload[leg]["tokens_per_sec"] > 0
+        assert payload[leg]["ttft_ms"]["p50"] > 0
+        assert payload[leg]["token_ms"]["p99"] >= \
+            payload[leg]["token_ms"]["p50"]
+    # Both legs generate the same token count from the same trace.
+    assert payload["continuous"]["tokens"] == payload["static"]["tokens"]
+    # Continuous batching must not LOSE throughput even on a loaded box.
+    assert payload["speedup"] >= 0.9, payload
+
+
 @pytest.mark.slow
 def test_bench_failure_still_emits_contract_json():
     """A dead backend: the probe retries with backoff inside the budget

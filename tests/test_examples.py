@@ -68,6 +68,29 @@ def test_transformer_lm_example():
 
 
 @pytest.mark.slow
+def test_transformer_lm_export_then_serve_lm_example(tmp_path):
+    """Train → --export → serve_lm one-shot generation, end to end
+    through the serving checkpoint (hvd-serve, docs/inference.md)."""
+    ckpt = str(tmp_path / "lm-ckpt")
+    out = _run_example("transformer_lm.py",
+                       {"HVD_TPU_EXAMPLE_STEPS": "5"},
+                       args=("--export", ckpt))
+    assert "serving checkpoint exported" in out
+    assert os.path.exists(os.path.join(ckpt, "params.msgpack"))
+    assert os.path.exists(os.path.join(ckpt, "serving.json"))
+    out = _run_example("serve_lm.py",
+                       args=(ckpt, "--tokens", "5,3,8,1", "-n", "8"))
+    assert "serve_lm: OK" in out
+    line = [ln for ln in out.splitlines()
+            if ln.strip().startswith("{")][0]
+    import json
+
+    resp = json.loads(line)
+    assert len(resp["tokens"]) == 8
+    assert all(0 <= t < 512 for t in resp["tokens"])
+
+
+@pytest.mark.slow
 def test_resnet50_synthetic_example():
     # Start cold: the example resumes from its fixed checkpoint path.
     ckpt = "/tmp/horovod_tpu_resnet50/ckpt.msgpack"
