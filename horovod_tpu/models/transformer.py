@@ -469,8 +469,19 @@ def forward_step(params, tokens, start_pos, k_view, v_view,
     k_news, v_news = [], []
 
     def put(view_b, new_b, start_b):
-        return jax.lax.dynamic_update_slice_in_dim(
-            view_b, new_b, jnp.clip(start_b, 0, None), axis=0)
+        # Per-row scatter, NOT dynamic_update_slice: a slice window is
+        # clamped as a whole, so a decode block [token, dummy] landing
+        # at start == capacity-1 would shift back one position —
+        # overwriting the previous token's entry and leaving the dummy
+        # unmasked at capacity-1.  mode="drop" keeps every row at its
+        # true index and discards rows past the capacity.  (hvd-serve's
+        # scheduler evicts one step before that boundary; this keeps
+        # forward_step's own contract exact for any caller stepping at
+        # the final cached position.)
+        idx = jnp.clip(start_b, 0, None) + jnp.arange(
+            new_b.shape[0], dtype=jnp.int32)
+        return view_b.at[idx].set(new_b, mode="drop",
+                                  unique_indices=True)
 
     for i in range(cfg.n_layers):
         lp = _index_layer(params["layers"], i)

@@ -48,11 +48,13 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from .. import telemetry as _telemetry
+from ..analysis import lockorder as _lockorder
 from ..core.topology import MODEL_AXIS
 from ..models import transformer as _transformer
 from ..ops import megakernel as _megakernel
 from .kv_cache import PagedKVCache
-from .scheduler import ContinuousBatchingScheduler, Request
+from .scheduler import (ContinuousBatchingScheduler, FinishReason,
+                        Request)
 
 _M_TTFT = _telemetry.histogram(
     "serving.ttft_seconds", "seconds",
@@ -77,9 +79,18 @@ class InferenceEngine:
     :class:`~horovod_tpu.models.transformer.TransformerConfig`.  With a
     ``mesh`` that has a ``model`` axis, the KV head axis and the
     attention/FFN compute shard over it exactly like the training
-    forward (the ``parallel/tensor.py`` layout, via GSPMD).  All public
-    methods are meant to be driven from ONE thread (the serve loop);
-    ``submit`` alone is thread-safe (the scheduler's lock).
+    forward (the ``parallel/tensor.py`` layout, via GSPMD).  Threading
+    contract: the data plane (``step``/``follow``/``generate``/
+    ``run_until_idle``) is driven from ONE thread (the serve loop);
+    ``submit`` is thread-safe (the scheduler's lock), and the
+    drain-family methods — ``drain``, ``import_requests``,
+    ``export_requests`` — may run from other threads (the elastic
+    resize path) concurrently with the loop, serialized by
+    ``_drain_lock``.  ``abort_all`` is the exception: it broadcasts on
+    the control plane, so under multiprocess it must be called from
+    the serve-loop thread only (between iterations — see its
+    docstring); single-process callers may treat it like the rest of
+    the drain family.
     """
 
     def __init__(self, params: Any, cfg, *, mesh=None, max_slots: int = 8,
@@ -89,7 +100,12 @@ class InferenceEngine:
         cap = capacity if capacity is not None else cfg.max_seq_len
         cap = min(cap, cfg.max_seq_len)
         cap -= cap % page_size
-        if cap < 2 * page_size and cap < cfg.max_seq_len:
+        # Compare against the page-floored max_seq_len, or the default
+        # capacity (None -> max_seq_len) is spuriously rejected when
+        # page_size < max_seq_len < 2*page_size with an unaligned
+        # max_seq_len.
+        max_cap = cfg.max_seq_len - cfg.max_seq_len % page_size
+        if cap < 2 * page_size and cap < max_cap:
             raise ValueError(
                 f"capacity {capacity} too small for page_size "
                 f"{page_size} (needs >= 2 pages' worth or "
@@ -123,6 +139,16 @@ class InferenceEngine:
         self._last_token = np.zeros((max_slots,), np.int32)
         self._ready = False
         self._drained = False
+        # Serializes drain/abort_all/import_requests: the serve loop's
+        # recovery and the elastic thread's drain_commit run
+        # concurrently, and "_drained" check-then-acts must be atomic
+        # with the scheduler drain they guard (or a recovery could
+        # re-open admission after a commit and silently lose requests).
+        # Ordering: _drain_lock is taken BEFORE scheduler._lock, never
+        # across a collective (which can block indefinitely).
+        self._drain_lock = _lockorder.make_lock(
+            "serving.InferenceEngine._drain_lock")
+        self._manifest_dir: Optional[str] = None  # warm_start override
 
     # -- readiness / warm start -------------------------------------------
     @property
@@ -131,6 +157,13 @@ class InferenceEngine:
         readiness bit (NOT_READY before; the load-balancer keeps
         traffic away until the executables exist)."""
         return self._ready
+
+    def mark_unready(self) -> None:
+        """Failure latch: flip ``/healthz`` back to NOT_READY.  Called
+        when recovery itself failed and the engine's state can no
+        longer be trusted — the load balancer drains traffic instead
+        of feeding requests into a blackhole."""
+        self._ready = False
 
     def health(self) -> Tuple[bool, dict]:
         """Exporter health contributor (exporter.register_health)."""
@@ -148,7 +181,15 @@ class InferenceEngine:
         mark the engine ready.  On a relaunch with a warm
         ``HVD_TPU_COMPILE_CACHE_DIR`` the compiles are disk-cache
         reads — the fleet serves at full token rate from the first
-        request.  Returns the number of manifest entries rebuilt."""
+        request.  A non-None ``directory`` is also where this engine
+        RECORDS its executables from now on (read and write sides must
+        agree, or a custom warm-start dir never accumulates entries); a
+        ``None`` directory keeps a previously chosen one rather than
+        reverting to the env default.  Returns the number of manifest
+        entries rebuilt."""
+        if directory is None:
+            directory = self._manifest_dir
+        self._manifest_dir = directory
         ident = self._manifest_identity()
         warmed = 0
         for entry in _megakernel.serving_entries(directory):
@@ -204,7 +245,7 @@ class InferenceEngine:
         entry = dict(self._manifest_identity())
         entry["kind"] = kind
         entry["bucket"] = bucket
-        _megakernel.record_manifest_entry(entry)
+        _megakernel.record_manifest_entry(entry, self._manifest_dir)
 
     # -- executables -------------------------------------------------------
     def _aot(self, key: Tuple, fn, args: Tuple) -> Any:
@@ -241,7 +282,11 @@ class InferenceEngine:
             v_view = v_pages[:, table].reshape(L, B, pps * ps, H, hd)
             # Width-2 block: [token, dummy]; the dummy column keeps the
             # gemms off XLA:CPU's bitwise-divergent single-row path and
-            # is never sampled nor scattered.
+            # is never sampled nor scattered.  The scheduler evicts at
+            # prompt+generated == capacity, so the deepest decode here
+            # runs at length == capacity-2 and the block always fits
+            # the view; forward_step itself stays exact one position
+            # further (it drops, not clamps, a row past the capacity).
             blk = jnp.stack([tokens, jnp.zeros_like(tokens)], axis=1)
             logits, k_new, v_new = _transformer.forward_step(
                 params, blk, lengths, k_view, v_view, cfg)
@@ -299,12 +344,19 @@ class InferenceEngine:
     # -- request surface ---------------------------------------------------
     def submit(self, prompt: List[int], max_new_tokens: int = 32,
                eos_id: Optional[int] = None, temperature: float = 0.0,
-               seed: int = 0, arrival: int = 0) -> Request:
+               seed: int = 0, arrival: int = 0,
+               prefix: Optional[List[int]] = None) -> Request:
+        """``prefix`` (relaunch continuations) is attached BEFORE the
+        request enters the queue: a live serve loop may admit and
+        sample it immediately, and the sampling rng keys on
+        ``len(prefix) + len(generated)``."""
         req = Request(prompt=[int(t) for t in prompt],
                       max_new_tokens=max_new_tokens,
                       eos_id=self.eos_id if eos_id is None else eos_id,
                       temperature=temperature, seed=seed,
                       arrival=arrival)
+        if prefix is not None:
+            req.prefix = list(prefix)
         req.t_submit = time.perf_counter()
         return self.scheduler.submit(req)
 
@@ -351,6 +403,13 @@ class InferenceEngine:
         for slot, req in admitted:
             self._prefill_and_sample(slot, req)
         active = self.scheduler.active()
+        # Page allocation (the host-side step that can raise — out of
+        # pages) runs BEFORE the decode announcement: once a follower
+        # reads a non-empty "decode" list it enters the compiled
+        # program's collectives and cannot be reached by an abort
+        # marker, so everything fallible on the host must happen first.
+        for slot, _ in active:
+            self.cache.ensure(slot, self.cache.length(slot))
         if mp:
             # Post-prefill sync: first sampled tokens + which slots
             # survived into the decode batch (a max_new_tokens=1
@@ -383,10 +442,13 @@ class InferenceEngine:
             req.t_first_token = time.perf_counter()
             _M_TTFT.observe(req.t_first_token - req.t_submit)
         _M_TOKENS.inc()
-        reason = self.scheduler.feed(slot, token)
+        # expect=req: a concurrent drain may have evicted the slot
+        # mid-iteration — the token is then discarded (the exported
+        # continuation reproduces it) instead of poisoning the step.
+        reason = self.scheduler.feed(slot, token, expect=req)
         if reason is not None:
             req.t_done = time.perf_counter()
-            self.cache.free_slot(slot)
+            self.cache.free_slot(slot)  # idempotent vs the drain
         else:
             self._last_token[slot] = token
 
@@ -401,16 +463,16 @@ class InferenceEngine:
         compiled = self._prefill_exec(bucket)
         last, kp, vp = compiled(
             self.params, self.cache.k_pages, self.cache.v_pages,
-            self._rep(self.cache._table[slot:slot + 1]),
+            self._rep(self.cache.table_row(slot)),
             self._rep(np.asarray([n], np.int32)), self._rep(tokens))
         self.cache.replace_pages(kp, vp)
         _M_PREFILLS.inc()
         return np.asarray(last)
 
     def _decode_iteration(self, active) -> np.ndarray:
+        """One batched decode over ``active``; the caller (step) has
+        already run ``cache.ensure`` for every slot."""
         t0 = time.perf_counter()
-        for slot, _ in active:
-            self.cache.ensure(slot, self.cache.length(slot))
         table, lengths = self.cache.device_tables()
         tokens = np.zeros((self.max_slots,), np.int32)
         for slot, _ in active:
@@ -464,14 +526,26 @@ class InferenceEngine:
         does, then apply its sampled tokens/evictions to the local
         cache mirror.  Returns False when rank 0 announced shutdown
         (:meth:`stop_followers`).  Worker ranks have no scheduler —
-        rank 0 decides, the data plane stays SPMD."""
+        rank 0 decides, the data plane stays SPMD.
+
+        Any of the three receptions may instead carry rank 0's
+        ``abort`` marker (:meth:`abort_all` after a poisoned step died
+        mid-iteration): the worker mirrors the recovery by freeing
+        every cache slot and returning, keeping the fleet's caches
+        identical for the next iteration."""
         plan = self._bcast(None)
         if plan.get("stop"):
             return False
+        if plan.get("abort"):
+            self._free_all_slots()
+            return True
         for slot, prompt in plan.get("admit", ()):
             self._prefill(slot, Request(prompt=list(prompt)),
                           prompt=list(prompt))
         sync = self._bcast(None)
+        if sync.get("abort"):
+            self._free_all_slots()
+            return True
         for slot, token in sync.get("last", {}).items():
             self._last_token[int(slot)] = int(token)
         for slot in sync.get("evict", ()):
@@ -491,6 +565,12 @@ class InferenceEngine:
                 table, lengths, self._rep(tokens))
             self.cache.replace_pages(kp, vp)
             fed = self._bcast(None)
+            if fed.get("abort"):
+                # Rank 0's _decode_iteration died before broadcasting
+                # the sampled tokens; it freed everything — mirror
+                # that (and skip the advance: rank 0 never advanced).
+                self._free_all_slots()
+                return True
             for slot in decode:
                 self.cache.advance(slot)
             for slot, token in fed.get("tokens", {}).items():
@@ -505,64 +585,144 @@ class InferenceEngine:
             self._bcast({"stop": True})
 
     # -- elastic drain / resume -------------------------------------------
+    @staticmethod
+    def _export_request(req: Request) -> dict:
+        """A request as a resubmittable continuation: prompt extended
+        by what it generated so far (the bitwise prefill≡decode
+        contract makes the continuation reproduce the uninterrupted
+        greedy rollout).  A queued request has ``generated == []``, so
+        this reduces to its original submission.  ``generated`` is read
+        ONCE: export_requests() can run concurrently with the serve
+        loop's feed(), and deriving the three fields from different
+        generation states would commit an internally inconsistent
+        continuation."""
+        gen = list(req.generated)
+        return {
+            "prompt": list(req.prompt) + gen,
+            "generated_prefix": list(req.prefix) + gen,
+            "max_new_tokens": req.max_new_tokens - len(gen),
+            "eos_id": req.eos_id, "temperature": req.temperature,
+            "seed": req.seed,
+        }
+
     def export_requests(self) -> List[dict]:
-        """Queued + in-flight work as resubmittable dicts: in-flight
-        sequences become continuations (prompt extended by what they
-        generated so far; the bitwise prefill≡decode contract makes
-        the continuation reproduce the uninterrupted greedy rollout).
-        Does not stop the engine — pair with :meth:`drain` for the
-        elastic resize path (:class:`horovod_tpu.elastic.ServingState`).
+        """Queued + in-flight work as resubmittable dicts (one atomic
+        scheduler snapshot — a request admitted concurrently cannot fall
+        between the active and pending halves).  Does not stop the
+        engine — pair with :meth:`drain` for the elastic resize path
+        (:class:`horovod_tpu.elastic.ServingState`).
         """
-        out = []
-        for _, req in self.scheduler.active():
-            out.append({
-                "prompt": list(req.prompt) + list(req.generated),
-                "generated_prefix": list(req.prefix)
-                + list(req.generated),
-                "max_new_tokens": req.max_new_tokens - len(req.generated),
-                "eos_id": req.eos_id, "temperature": req.temperature,
-                "seed": req.seed,
-            })
-        for req in self.scheduler.pending():
-            out.append({
-                "prompt": list(req.prompt),
-                "generated_prefix": list(req.prefix),
-                "max_new_tokens": req.max_new_tokens,
-                "eos_id": req.eos_id, "temperature": req.temperature,
-                "seed": req.seed,
-            })
-        return out
+        active, pending = self.scheduler.snapshot()
+        return [self._export_request(req)
+                for req in [r for _, r in active] + pending]
 
     def drain(self) -> List[dict]:
         """Serving-fleet resize, step 1: capture every queued and
         in-flight request as a continuation, then evict everything and
-        stop admission.  The returned list (same format as
-        :meth:`export_requests`) is what the elastic commit persists;
-        a relaunched engine resubmits it via :meth:`import_requests`."""
-        exported = self.export_requests()
-        self.scheduler.drain()
+        stop admission.  The export is built from exactly the requests
+        the scheduler's drain removed (one lock hold), so a submission
+        racing the drain is either exported or rejected — never lost.
+        The returned list (same format as :meth:`export_requests`) is
+        what the elastic commit persists; a relaunched engine resubmits
+        it via :meth:`import_requests`."""
+        with self._drain_lock:
+            self._drained = True
+            drained, pending = self._drain_and_finish(
+                FinishReason.DRAINED)
+        return [self._export_request(req) for req in drained + pending]
+
+    def _free_all_slots(self) -> None:
         for slot in range(self.max_slots):
             if self.cache.length(slot) >= 0:
                 self.cache.free_slot(slot)
-        self._drained = True
-        return exported
+
+    def _drain_and_finish(self, reason: str):
+        """The shared eviction sequence (caller holds ``_drain_lock``):
+        scheduler drain with ``reason``, free every KV slot, and finish
+        the still-queued requests' Python objects with the same reason
+        — their blocked /generate handlers fail fast instead of hanging
+        to the client timeout (the relaunch path resubmits NEW Request
+        objects from the export, so finishing these loses nothing).
+        Returns ``(drained, pending)``."""
+        drained, pending = self.scheduler.drain(reason)
+        self._free_all_slots()
+        for req in pending:
+            req.finish_reason = reason
+            req.done.set()
+        return drained, pending
+
+    def abort_all(self) -> List[Request]:
+        """Error recovery (the serve loop's poisoned-step path):
+        atomically evict and FAIL every queued and in-flight request —
+        ``finish_reason`` is ``"error"`` before ``done`` is set, so a
+        blocked ``/generate`` handler can never observe a stale reason —
+        free the KV slots, and re-open admission.  Unlike :meth:`drain`
+        nothing is exported: callers answer the failed requests
+        immediately instead of requeueing them.  Returns the failed
+        requests (raced submissions included).
+
+        Admission re-opens ONLY when no elastic :meth:`drain` is
+        pending (checked under the same lock the drain holds, so the
+        recovery cannot interleave with a concurrent drain_commit and
+        resume after it): if the loop's recovery fires after a drain
+        committed, resuming here would admit requests the commit never
+        captured — silently lost at relaunch.
+
+        Multi-host: broadcasts an abort marker so blocked
+        :meth:`follow` ranks (waiting for the sync/tokens of the step
+        that just died) free their cache mirrors too — without it the
+        fleet's caches diverge and every later decode breaks the
+        bitwise contract."""
+        # Broadcast OUTSIDE the lock: a wedged control plane blocks a
+        # collective forever (no timeout), and holding _drain_lock
+        # across it would deadlock the elastic thread's drain/import
+        # too.  Under multiprocess only the serve-loop thread may call
+        # abort_all (the class threading contract), so the marker
+        # cannot interleave with a concurrent step()'s broadcasts — a
+        # follower consuming an abort where it expected a plan/sync
+        # would silently desynchronize the fleet's caches.
+        if self._multiprocess():
+            try:
+                self._bcast({"abort": True})
+            except Exception:  # noqa: BLE001 — a dead control
+                pass  # plane must not stop the LOCAL recovery
+        with self._drain_lock:
+            drained, pending = self._drain_and_finish(
+                FinishReason.ERROR)
+            if not self._drained:
+                self.scheduler.resume()
+        return drained + pending
 
     def import_requests(self, exported: List[dict]) -> List[Request]:
         """Resubmit a drained export (relaunch path).  Continuation
         requests keep their already-generated prefix, so callers see
-        uninterrupted results."""
-        if self._drained:
-            self.scheduler.resume()
-            self._drained = False
-        out = []
-        for d in exported:
-            if d.get("max_new_tokens", 0) <= 0:
-                continue
-            req = self.submit(
-                d["prompt"], max_new_tokens=d["max_new_tokens"],
-                eos_id=d.get("eos_id"),
-                temperature=d.get("temperature", 0.0),
-                seed=d.get("seed", 0))
-            req.prefix = list(d.get("generated_prefix", []))
-            out.append(req)
+        uninterrupted results.  The whole resume+resubmit runs under
+        the drain lock: a concurrent abort_all/drain landing mid-loop
+        would otherwise make ``submit`` raise and silently drop the
+        not-yet-resubmitted tail of the committed export.  A
+        continuation this engine cannot admit (its prompt outgrew a
+        SHRUNK capacity across the resize) is skipped with a flight-
+        recorder event — one oversized request must not abort the loop
+        and drop the rest of the committed export with it."""
+        with self._drain_lock:
+            if self._drained:
+                self.scheduler.resume()
+                self._drained = False
+            out = []
+            for d in exported:
+                if d.get("max_new_tokens", 0) <= 0:
+                    continue
+                try:
+                    out.append(self.submit(
+                        d["prompt"], max_new_tokens=d["max_new_tokens"],
+                        eos_id=d.get("eos_id"),
+                        temperature=d.get("temperature", 0.0),
+                        seed=d.get("seed", 0),
+                        prefix=d.get("generated_prefix", [])))
+                except ValueError as e:
+                    _telemetry.exception_event(
+                        "serve-import",
+                        f"dropping unresumable continuation "
+                        f"({len(d['prompt'])} prompt tokens vs "
+                        f"capacity {self.capacity}): {e}")
         return out

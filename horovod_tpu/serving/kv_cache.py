@@ -38,14 +38,20 @@ import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..analysis import lockorder as _lockorder
 from ..core.topology import MODEL_AXIS
 
 
 class PagedKVCache:
     """The paged store for one :class:`~horovod_tpu.serving.engine.
-    InferenceEngine`.  Not thread-safe on its own — the engine's
-    iteration loop is the only writer (the scheduler lock serializes
-    everything upstream of it)."""
+    InferenceEngine`.  The host-side bookkeeping (page table, lengths,
+    free list) is guarded by an internal lock: the serve loop mutates
+    it every iteration, and the engine's drain family
+    (``_free_all_slots``) may run concurrently from the elastic
+    thread — ``free_slot`` is idempotent and ``advance`` is a no-op on
+    a freed slot, so an eviction racing the loop can never double-free
+    a page or resurrect a slot.  The DEVICE page arrays are still
+    single-writer (only the serve loop dispatches executables)."""
 
     def __init__(self, n_layers: int, n_heads: int, head_dim: int,
                  max_slots: int, pages_per_slot: int, page_size: int,
@@ -75,7 +81,9 @@ class PagedKVCache:
         self.k_pages = k
         self.v_pages = v
 
+        self._lock = _lockorder.make_lock("serving.PagedKVCache._lock")
         self._free: List[int] = list(range(1, self.n_pages))
+        # guarded_by: _lock
         self._table = np.zeros((max_slots, pages_per_slot), np.int32)
         self._lengths = np.full((max_slots,), -1, np.int32)
 
@@ -101,15 +109,27 @@ class PagedKVCache:
     def begin_slot(self, slot: int, n_tokens: int) -> None:
         """Map pages for a freshly admitted sequence's first
         ``n_tokens`` positions (the prompt) and set its length."""
-        if self._lengths[slot] >= 0:
-            raise ValueError(f"slot {slot} already active")
-        self._table[slot] = 0
-        self._lengths[slot] = 0
-        self.ensure(slot, n_tokens - 1)
-        self._lengths[slot] = n_tokens
+        with self._lock:
+            if self._lengths[slot] >= 0:
+                raise ValueError(f"slot {slot} already active")
+            self._table[slot] = 0
+            self._lengths[slot] = 0
+            self._ensure_locked(slot, n_tokens - 1)
+            self._lengths[slot] = n_tokens
 
     def ensure(self, slot: int, pos: int) -> None:
-        """Map pages so position ``pos`` of ``slot`` is writable."""
+        """Map pages so position ``pos`` of ``slot`` is writable.
+        A no-op on a freed slot: the serve loop reads ``length`` and
+        calls this as two separate lock holds, so a drain landing
+        between them must not map pages into the freed slot — its own
+        idempotence check would then never recycle them (a permanent
+        page leak), and ``begin_slot`` zeroes the row on reuse."""
+        with self._lock:
+            if self._lengths[slot] < 0:
+                return
+            self._ensure_locked(slot, pos)
+
+    def _ensure_locked(self, slot: int, pos: int) -> None:
         if pos >= self.capacity:
             raise ValueError(
                 f"position {pos} exceeds per-slot capacity "
@@ -125,31 +145,53 @@ class PagedKVCache:
 
     def advance(self, slot: int) -> int:
         """One decoded token was written at the current length; map the
-        page first via :meth:`ensure`.  Returns the new length."""
-        self._lengths[slot] += 1
-        return int(self._lengths[slot])
+        page first via :meth:`ensure`.  Returns the new length, or -1
+        without advancing when the slot was freed by a concurrent
+        eviction (a drain racing the loop must not resurrect it)."""
+        with self._lock:
+            if self._lengths[slot] < 0:
+                return -1
+            self._lengths[slot] += 1
+            return int(self._lengths[slot])
 
     def free_slot(self, slot: int) -> None:
-        """Evict: recycle the slot's pages onto the free list."""
-        for p in range(self.pages_per_slot):
-            page = int(self._table[slot, p])
-            if page != 0:
-                self._free.append(page)
-        self._table[slot] = 0
-        self._lengths[slot] = -1
+        """Evict: recycle the slot's pages onto the free list.
+        Idempotent — a second free of the same slot (the serve loop
+        and a concurrent drain both evicting) is a no-op, never a
+        double-insert into the free list."""
+        with self._lock:
+            if self._lengths[slot] < 0:
+                return
+            for p in range(self.pages_per_slot):
+                page = int(self._table[slot, p])
+                if page != 0:
+                    self._free.append(page)
+            self._table[slot] = 0
+            self._lengths[slot] = -1
 
     def length(self, slot: int) -> int:
-        return int(self._lengths[slot])
+        with self._lock:
+            return int(self._lengths[slot])
 
     def free_pages(self) -> int:
-        return len(self._free)
+        with self._lock:
+            return len(self._free)
+
+    def table_row(self, slot: int) -> np.ndarray:
+        """One slot's page-table row, ``[1, pages_per_slot]`` (a copy —
+        the live table may be mutated by a concurrent eviction)."""
+        with self._lock:
+            return self._table[slot:slot + 1].copy()
 
     # -- device views ------------------------------------------------------
     def device_tables(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """(page_table, lengths) as device arrays for the executables
         (replicated under a mesh — they are tiny)."""
-        table = jnp.asarray(self._table)
-        lengths = jnp.asarray(self._lengths)
+        with self._lock:
+            table_np = self._table.copy()
+            lengths_np = self._lengths.copy()
+        table = jnp.asarray(table_np)
+        lengths = jnp.asarray(lengths_np)
         if self.mesh is not None and self.page_sharding() is not None:
             rep = NamedSharding(self.mesh, P())
             table = jax.device_put(table, rep)

@@ -33,9 +33,25 @@ from typing import Optional, Tuple
 from .. import telemetry as _telemetry
 from ..telemetry import exporter as _exporter
 from .engine import InferenceEngine
+from .scheduler import FinishReason
 
 HEALTH_KEY = "serving"
 GENERATE_PATH = "/generate"
+
+# finish_reason -> (HTTP status, message) for requests that did not
+# complete normally.  500: the serve loop's error recovery failed it.
+# 503: an elastic drain evicted it mid-flight — the engine exported a
+# continuation for the relaunched fleet, but THIS handler's request
+# object never completes, so the client retries (consistent with the
+# 503 a drained submit gets).
+_FAILURE_STATUS = {
+    FinishReason.ERROR: (
+        500, "generation failed (engine error); partial tokens "
+             "included"),
+    FinishReason.DRAINED: (
+        503, "generation interrupted by a serving-fleet drain; retry "
+             "against the relaunched fleet"),
+}
 
 
 def encode_text(text: str, vocab_size: int) -> list:
@@ -140,17 +156,27 @@ class LMServer:
             except Exception as e:  # noqa: BLE001 — the loop must
                 # survive one bad batch; the flight recorder keeps the
                 # forensics, every caught-up request fails FAST (not at
-                # its HTTP timeout), and the engine drain frees the KV
-                # slots/pages so the next request serves normally.
+                # its HTTP timeout) — abort_all fails exactly the
+                # requests its drain removed, so a submission racing
+                # the recovery cannot be silently lost — and the KV
+                # slots/pages are freed so the next request serves
+                # normally.
                 _telemetry.exception_event("serve-loop",
                                            f"{type(e).__name__}: {e}")
-                pending = self.engine.scheduler.pending()
-                active = [r for _, r in self.engine.scheduler.active()]
-                self.engine.drain()
-                self.engine.import_requests([])  # re-open admission
-                for req in active + pending:
-                    req.finish_reason = "error"
-                    req.done.set()
+                try:
+                    self.engine.abort_all()
+                except Exception as e2:  # noqa: BLE001 — a recovery
+                    # that raises must not kill this thread: a dead
+                    # serve loop with a still-ready /healthz blackholes
+                    # every future request until its client timeout.
+                    # But a FAILED recovery may have left admission
+                    # closed and requests unanswered — flip /healthz
+                    # to NOT_READY so the load balancer drains traffic
+                    # instead of feeding the blackhole.
+                    _telemetry.exception_event(
+                        "serve-loop-recovery",
+                        f"{type(e2).__name__}: {e2}")
+                    self.engine.mark_unready()
 
     # -- /generate ---------------------------------------------------------
     def _handle_generate(self, query: str,
@@ -181,8 +207,14 @@ class LMServer:
                 max_new_tokens=int(payload.get("max_tokens", 32)),
                 temperature=float(payload.get("temperature", 0.0)),
                 seed=int(payload.get("seed", 0)))
-        except (ValueError, RuntimeError) as e:
+        except ValueError as e:
             return (400, json.dumps({"error": str(e)}).encode(),
+                    "application/json")
+        except RuntimeError as e:
+            # The scheduler is draining (elastic resize or the error
+            # recovery's brief window) — a retryable server state, not
+            # a malformed request.
+            return (503, json.dumps({"error": str(e)}).encode(),
                     "application/json")
         self._wake.set()
         timeout = float(payload.get("timeout", 120.0))
@@ -193,6 +225,16 @@ class LMServer:
             return (504, json.dumps(
                 {"error": "generation timed out", "rid": req.rid}
             ).encode(), "application/json")
+        fail = _FAILURE_STATUS.get(req.finish_reason)
+        if fail is not None:
+            # Failures are explicit statuses, never a 200 that only
+            # finish_reason distinguishes from success (partial tokens
+            # included either way).
+            code, msg = fail
+            return (code, (json.dumps({
+                "error": msg,
+                "rid": req.rid, "finish_reason": req.finish_reason,
+                "tokens": out}) + "\n").encode(), "application/json")
         total = time.perf_counter() - t0
         resp = {
             "rid": req.rid,

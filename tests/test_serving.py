@@ -151,14 +151,49 @@ def test_scheduler_arrival_gating_and_drain():
     assert s.admit(now=4) == []
     assert [x[1] for x in s.admit(now=5)] == [r]
     s.feed(0, 1)
-    pending = s.drain()
-    assert pending == [] and r.finish_reason == FinishReason.DRAINED
+    drained, pending = s.drain()
+    assert drained == [r] and pending == []
+    assert r.finish_reason == FinishReason.DRAINED
     assert r.result(0) == [1]
     with pytest.raises(RuntimeError):
         s.submit(_req())
     s.resume()
     s.submit(_req())
     assert len(s.admit()) == 1
+
+
+def test_scheduler_snapshot_is_one_lock_hold_and_drain_reason():
+    """snapshot() returns (active, pending) atomically — the export
+    path's view; drain(reason) finishes in-flight sequences with the
+    caller's reason (set BEFORE done, so a blocked handler can never
+    read a stale one) and returns raced pending submissions too."""
+    s = ContinuousBatchingScheduler(max_slots=1, capacity=32)
+    r1 = s.submit(_req())
+    s.admit()
+    r2 = s.submit(_req())
+    active, pending = s.snapshot()
+    assert active == [(0, r1)] and pending == [r2]
+    drained, pending = s.drain(FinishReason.ERROR)
+    assert drained == [r1] and pending == [r2]
+    assert r1.finish_reason == FinishReason.ERROR and r1.done.is_set()
+    # Pending requests are returned for the CALLER to fail/requeue —
+    # drain itself must not touch them (the elastic path resubmits).
+    assert r2.finish_reason is None and not r2.done.is_set()
+
+
+def test_scheduler_feed_expect_tolerates_concurrent_eviction():
+    """feed(expect=req): when a concurrent drain evicted the slot (or
+    another request now holds it), the token is discarded and the
+    evicted request's finish reason is returned instead of raising —
+    a drain landing mid-iteration must not poison the step."""
+    s = ContinuousBatchingScheduler(max_slots=1, capacity=32)
+    r1 = s.submit(_req())
+    s.admit()
+    s.drain()
+    assert s.feed(0, 7, expect=r1) == FinishReason.DRAINED
+    assert r1.generated == []  # the token was discarded
+    with pytest.raises(ValueError):
+        s.feed(0, 7)  # without expect the strict contract remains
 
 
 def test_scheduler_rejects_bad_prompts():
@@ -192,6 +227,26 @@ def test_kv_cache_page_lifecycle_and_reuse():
         c.ensure(1, 32)  # beyond per-slot capacity
     with pytest.raises(ValueError):
         c.begin_slot(1, 2)  # already active
+
+
+def test_kv_cache_ensure_on_freed_slot_is_a_leakfree_noop():
+    """Regression (drain-vs-serve-loop page leak): step() reads
+    length(slot) and calls ensure(slot, n) as two lock holds, so a
+    drain freeing the slot between them must make ensure a no-op —
+    pages mapped into a freed slot are unreachable forever (free_slot
+    early-returns on length < 0 and begin_slot zeroes the row)."""
+    c = PagedKVCache(n_layers=1, n_heads=4, head_dim=8, max_slots=2,
+                     pages_per_slot=4, page_size=8)
+    c.begin_slot(0, 10)
+    n = c.length(0)
+    c.free_slot(0)  # the concurrent drain lands here
+    free_before = c.free_pages()
+    c.ensure(0, n)  # the loop's stale call: must not map pages
+    assert c.free_pages() == free_before
+    assert list(c._table[0]) == [0] * 4
+    c.begin_slot(0, 10)  # slot stays reusable, no pages lost
+    c.free_slot(0)
+    assert c.free_pages() == c.n_pages - 1
 
 
 def test_kv_cache_sharding_requires_model_axis():
@@ -238,6 +293,38 @@ def test_prefill_plus_decode_bitwise_equals_noncached_forward():
                 == ref[:, P + t:P + t + 1].tobytes()), f"step {t}"
         k = scatter(k, kn[:, :, :1], pos)
         v = scatter(v, vn[:, :, :1], pos)
+
+
+def test_decode_at_final_capacity_position_is_bitwise():
+    """Regression (width-2 decode at the capacity boundary): a decode
+    block [token, dummy] landing at start == capacity-1 used to go
+    through a clamped slice-update that shifted the whole window back
+    one position — overwriting the previous token's K/V with the
+    current token's and leaving the dummy's K/V unmasked at
+    capacity-1.  forward_step must instead keep the real token at its
+    true index and drop the dummy column."""
+    b, cap = 2, 16
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (b, cap), 0,
+                                CFG.vocab_size).astype(jnp.int32)
+    hd = CFG.d_model // CFG.n_heads
+    zeros = jnp.zeros((CFG.n_layers, b, cap, CFG.n_heads, hd), CFG.dtype)
+    z = jnp.zeros((b,), jnp.int32)
+    step = jax.jit(forward_step, static_argnums=(5,))
+    ref, _, _ = step(PARAMS, tokens, z, zeros, zeros, CFG)
+    # Prefill the first cap-1 positions, then decode the final one.
+    _, kn, vn = step(PARAMS, tokens[:, :cap - 1], z, zeros, zeros, CFG)
+    k = zeros.at[:, :, :cap - 1].set(kn)
+    v = zeros.at[:, :, :cap - 1].set(vn)
+    pos = jnp.full((b,), cap - 1, jnp.int32)
+    blk = jnp.concatenate([tokens[:, cap - 1:],
+                           jnp.zeros((b, 1), jnp.int32)], axis=1)
+    logits, kn2, _ = step(PARAMS, blk, pos, k, v, CFG)
+    assert (np.asarray(logits)[:, 0].tobytes()
+            == np.asarray(ref)[:, cap - 1].tobytes())
+    # The returned new-token K is the real token's (scatter-back input).
+    _, k_ref, _ = step(PARAMS, tokens, z, zeros, zeros, CFG)
+    assert (np.asarray(kn2[:, :, 0]).tobytes()
+            == np.asarray(k_ref[:, :, cap - 1]).tobytes())
 
 
 def test_ragged_batch_masking_matches_per_sequence_runs():
@@ -340,6 +427,31 @@ def test_engine_greedy_matches_reference_and_batch_invariance():
     reqs = [eng2.submit(list(p), max_new_tokens=7) for p in prompts]
     eng2.run_until_idle()
     assert [r.result(0) for r in reqs] == ref
+
+
+@pytest.mark.parametrize("capacity", [32, 64])
+def test_engine_capacity_finished_rollout_is_bitwise(capacity):
+    """A CAPACITY-finished rollout (prompt + max_new_tokens over the
+    KV capacity, no earlier EOS) must match the non-incremental
+    forward bitwise — both schedulers produce the same tokens either
+    way, so only a reference comparison can catch a boundary bug
+    here.  The scheduler evicts the moment prompt+generated hits
+    capacity, so the deepest decode runs at length == capacity-2 and
+    writes [token, dummy] into the view's last two entries;
+    forward_step staying exact at length == capacity-1 as well is
+    gated by test_decode_at_final_capacity_position_is_bitwise.
+    capacity == max_seq_len (64, the engine default) additionally
+    exercises the decode block's final-position path end to end."""
+    eng = make_engine(capacity=capacity)
+    eng.warm_start()
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(7), (capacity - 4,), 0, CFG.vocab_size)]
+    req = eng.submit(list(prompt), max_new_tokens=99)
+    eng.run_until_idle()
+    out = req.result(0)
+    assert req.finish_reason == FinishReason.CAPACITY
+    assert len(prompt) + len(out) == eng.capacity
+    assert out == reference_rollout(prompt, len(out), eng.capacity)
 
 
 def test_engine_eos_and_sampling_determinism():
@@ -559,6 +671,17 @@ def test_lmserver_generate_http_and_readiness():
                     pytest.fail("expected 400")
                 except urllib.error.HTTPError as e:
                     assert e.code == 400
+            # Drained admission is a retryable 503, not a client 400.
+            engine.scheduler.drain()
+            try:
+                _post(base + "/generate", {"tokens": prompt})
+                pytest.fail("expected 503 while draining")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+            engine.scheduler.resume()
+            status, resp3 = _post(base + "/generate",
+                                  {"tokens": prompt, "max_tokens": 6})
+            assert resp3["tokens"] == ref
             # /metrics still served by the same listener.
             status, snap = _get(base + "/metrics?format=json")
             assert status == 200
@@ -568,12 +691,56 @@ def test_lmserver_generate_http_and_readiness():
         routes.unregister_health("serving")
 
 
+def test_engine_abort_all_fails_everything_and_reopens():
+    """abort_all (the serve loop's recovery): every queued AND
+    in-flight request is failed with finish_reason='error' and done
+    set, the KV pages are recycled, and admission re-opens — the
+    returned list is exactly what the drain removed, so a submission
+    racing the recovery is failed fast instead of silently lost."""
+    eng = make_engine()
+    eng.warm_start()
+    reqs = [eng.submit([i + 1, 2, 3], max_new_tokens=8)
+            for i in range(4)]  # 3 slots -> one stays queued
+    eng.step()
+    assert eng.scheduler.occupancy() == 3
+    failed = eng.abort_all()
+    assert {r.rid for r in failed} == {r.rid for r in reqs}
+    for r in reqs:
+        assert r.done.is_set() and r.finish_reason == FinishReason.ERROR
+    assert eng.cache.free_pages() == eng.cache.n_pages - 1
+    assert eng.generate([1, 2], max_new_tokens=2)  # admission re-open
+
+
+def test_follow_applies_abort_marker_and_abort_all_broadcasts_it():
+    """Multi-host recovery: abort_all broadcasts an abort marker, and a
+    follower receiving it (here scripted as the post-prefill sync of a
+    step that died on rank 0) frees its whole cache mirror — without
+    this the fleet's caches diverge after a poisoned step and every
+    later decode breaks the bitwise contract."""
+    eng = make_engine()
+    msgs = [{"stop": False, "admit": [(0, [1, 2, 3])]}, {"abort": True}]
+    eng._bcast = lambda obj: msgs.pop(0)
+    assert eng.follow() is True
+    assert msgs == [] and eng.cache.length(0) < 0
+    assert eng.cache.free_pages() == eng.cache.n_pages - 1
+    # Rank-0 side: abort_all under a live control plane broadcasts the
+    # marker so blocked followers unblock into the same recovery.
+    eng2 = make_engine()
+    sent = []
+    eng2._multiprocess = lambda: True
+    eng2._bcast = lambda obj: sent.append(obj)
+    eng2.submit([1, 2, 3], max_new_tokens=4)
+    eng2.abort_all()
+    assert {"abort": True} in sent
+
+
 def test_lmserver_survives_engine_exception_and_keeps_serving():
     """Error recovery: one poisoned step fails every caught-up request
-    FAST with finish_reason='error' (not 'drained', not a timeout),
-    frees the KV slots, and the server keeps serving new requests —
-    slot 0 must be reusable (regression: a recovery that drained only
-    the scheduler left the cache slots mapped and bricked admission)."""
+    FAST as an HTTP 500 with finish_reason='error' (not 'drained', not
+    a timeout, not a 200 masquerading as success), frees the KV slots,
+    and the server keeps serving new requests — slot 0 must be reusable
+    (regression: a recovery that drained only the scheduler left the
+    cache slots mapped and bricked admission)."""
     engine = make_engine()
     with LMServer(engine, port=0) as srv:
         srv.start()
@@ -589,11 +756,13 @@ def test_lmserver_survives_engine_exception_and_keeps_serving():
 
         engine._decode_iteration = poisoned
         try:
-            status, resp = _post(base + "/generate",
-                                 {"tokens": [1, 2, 3], "max_tokens": 6,
-                                  "timeout": 30})
+            _post(base + "/generate",
+                  {"tokens": [1, 2, 3], "max_tokens": 6,
+                   "timeout": 30})
+            pytest.fail("expected HTTP 500 for the failed request")
         except urllib.error.HTTPError as e:
-            pytest.fail(f"recovery path returned HTTP {e.code}")
+            assert e.code == 500
+            resp = json.loads(e.read())
         assert resp["finish_reason"] == "error", resp
         # The server is healthy again: same slot serves a new request.
         status, resp2 = _post(base + "/generate",
@@ -604,6 +773,39 @@ def test_lmserver_survives_engine_exception_and_keeps_serving():
         ref.warm_start()
         assert resp2["tokens"] == ref.generate([1, 2, 3],
                                                max_new_tokens=6)
+
+
+def test_lmserver_midflight_drain_returns_retryable_503():
+    """An elastic drain evicting an in-flight request must surface to
+    its blocked /generate handler as a retryable 503 with the partial
+    tokens and finish_reason='drained' — never a 200 that only
+    finish_reason distinguishes from success (the docs/inference.md
+    failure-status contract)."""
+    engine = make_engine()
+    with LMServer(engine, port=0) as srv:
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        orig = engine._decode_iteration
+
+        def draining(active):
+            engine._decode_iteration = orig
+            engine.drain()  # mid-flight eviction, continuation exported
+
+        engine._decode_iteration = draining
+        try:
+            _post(base + "/generate",
+                  {"tokens": [1, 2, 3], "max_tokens": 6, "timeout": 30})
+            pytest.fail("expected HTTP 503 for the drained request")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            resp = json.loads(e.read())
+        assert resp["finish_reason"] == "drained", resp
+        # Resume (the relaunch path) and the same server serves again.
+        engine.import_requests([])
+        status, resp2 = _post(base + "/generate",
+                              {"tokens": [1, 2, 3], "max_tokens": 6})
+        assert status == 200
+        assert resp2["finish_reason"] == "max_new_tokens"
 
 
 def test_lmserver_concurrent_http_requests():
@@ -681,6 +883,94 @@ def test_engine_drain_with_nothing_in_flight_is_empty():
     assert eng.drain() == []
     eng.import_requests([])  # resume with nothing
     assert eng.generate([1, 2], max_new_tokens=2)  # still serves
+
+
+def test_import_requests_attaches_prefix_before_admissible():
+    """A relaunched continuation's generated_prefix must be on the
+    Request BEFORE it enters the queue: a live serve loop can admit
+    and sample it immediately, and the sampling rng keys on
+    len(prefix) + len(generated) — a late prefix assignment would draw
+    from the wrong rng position and break continuation determinism."""
+    eng = make_engine()
+    eng.warm_start()
+    seen = []
+    orig_submit = eng.scheduler.submit
+
+    def spy(req):
+        seen.append(list(req.prefix))
+        return orig_submit(req)
+
+    eng.scheduler.submit = spy
+    eng.import_requests([{"prompt": [1, 2, 3, 9],
+                          "generated_prefix": [9],
+                          "max_new_tokens": 4, "seed": 1,
+                          "temperature": 0.7}])
+    assert seen == [[9]]
+
+
+def test_import_requests_skips_unresumable_continuations():
+    """A resize can SHRINK capacity; a drained continuation whose
+    prompt no longer fits must be skipped (flight-recorder event), not
+    abort the import loop and silently drop the rest of the committed
+    export behind it."""
+    eng = make_engine()  # capacity 64
+    oversized = {"prompt": list(range(eng.capacity + 4)),
+                 "generated_prefix": [], "max_new_tokens": 8}
+    ok = {"prompt": [1, 2, 3], "generated_prefix": [9],
+          "max_new_tokens": 4}
+    out = eng.import_requests([oversized, ok])
+    assert len(out) == 1 and out[0].prompt == [1, 2, 3]
+    assert out[0].prefix == [9]
+
+
+def test_engine_drain_finishes_pending_requests_fast():
+    """engine.drain() must also finish queued-but-unadmitted requests
+    (finish_reason='drained', done set): the relaunch resubmits NEW
+    Request objects from the export, so a /generate handler blocked on
+    the original would otherwise hang to its client timeout instead of
+    failing fast as a retryable 503."""
+    eng = make_engine()
+    eng.warm_start()
+    reqs = [eng.submit([i + 1, 2, 3], max_new_tokens=8)
+            for i in range(4)]  # 3 slots -> one stays queued
+    eng.step()
+    exported = eng.drain()
+    assert len(exported) == 4  # pending still exported for relaunch
+    for r in reqs:
+        assert r.done.is_set(), r.rid
+        assert r.finish_reason == FinishReason.DRAINED
+
+
+def test_engine_abort_all_survives_dead_control_plane():
+    """A control-plane fault that poisoned the step must not also kill
+    the recovery: abort_all's abort broadcast failing is swallowed and
+    the LOCAL drain/fail/reopen still completes."""
+    eng = make_engine()
+    eng.warm_start()
+    eng._multiprocess = lambda: True
+
+    def dead_bcast(obj):
+        raise ConnectionError("control plane down")
+
+    eng._bcast = dead_bcast
+    req = eng.submit([1, 2, 3], max_new_tokens=4)
+    failed = eng.abort_all()
+    assert req in failed and req.finish_reason == FinishReason.ERROR
+    eng._multiprocess = lambda: False
+    assert eng.generate([1, 2], max_new_tokens=2)  # admission re-open
+
+
+def test_engine_warm_start_none_keeps_chosen_manifest_dir(tmp_path):
+    """warm_start(None) after warm_start(dir) must keep recording to
+    dir (a later default-argument call — e.g. LMServer.start() with no
+    warm_start_dir — must not silently revert to the env default)."""
+    eng = make_engine()
+    eng.warm_start(str(tmp_path))
+    eng.warm_start()
+    assert eng._manifest_dir == str(tmp_path)
+    eng.generate([1, 2, 3], max_new_tokens=2)
+    man = json.loads((tmp_path / "megakernel_manifest.json").read_text())
+    assert any(e["variant"] == "serving" for e in man["entries"])
 
 
 # ---------------------------------------------------------------------------
