@@ -717,6 +717,215 @@ def _input_bench(steps: int = 40, batch: int = 64, dim: int = 512,
         hvd.shutdown()
 
 
+def _overlap_bench(steps: int = 12, warmup: int = 3, batch_per: int = 8,
+                   seq: int = 64) -> dict:
+    """Backward/communication-overlap microbench (``--mode overlap``):
+    steps/sec on a compute-heavy transformer-LM chain, monolithic vs
+    bucketed-backward, plus the bitwise param-identity gates.
+
+    Legs, all over one transformer-LM chain, one batch, one initial
+    state:
+
+    * ``monolithic`` — the pre-overlap static step (HVD_TPU_OVERLAP=off):
+      ONE compiled program, in-program bucketed psum.
+    * ``serialized`` — the same bucketed sub-programs with hard fences:
+      reduction strictly after backward (the "reduction serialized
+      after backward" symptom of docs/performance.md — what a
+      non-overlapped dynamic path would do).
+    * ``overlapped`` — streaming dispatch: each backward segment's
+      buckets hand their megakernel to the device while earlier
+      segments are still executing.
+
+    ``speedup`` is overlapped/serialized — the scheduling win at equal
+    device work (the honest overlap measure); the timed legs run as
+    ALTERNATING blocks and report the per-leg median so background load
+    hits both legs symmetrically.  ``vs_monolithic`` rides along for
+    context (on a CPU mesh the single-program static step may win it).
+    On the CPU mesh there is no comm/compute concurrency to exploit —
+    the 8 virtual devices and the host share one thread pool, which is
+    exactly why ``HVD_TPU_OVERLAP=auto`` resolves to ``off`` there — so
+    the CI floor asserts the streamed schedule costs at most a
+    scheduling-noise margin over the serialized one (parity on a quiet
+    box; same contract as the dataplane bench's int8 throughput floor),
+    not a CPU win.
+
+    Identity gates:
+
+    * ``bitwise_identical`` — the overlapped step's params ≡ the
+      monolithic step's, bitwise, via the single-backward streaming
+      schedule (same model, plain-callable loss).  The segmented
+      schedule's params are additionally gated ``serial_identical``
+      (≡ the serialized dispatch of the same sub-programs, bitwise —
+      structural: same programs, different interleaving) and reported/
+      checked against the monolithic step as ``segmented_close``
+      (allclose, rtol 1e-4 / atol 1e-5: Adam's per-coordinate
+      normalization can amplify a 1-ULP backward drift on a
+      near-zero-gradient coordinate to ~1e-6 after a few steps) +
+      ``segmented_bitwise`` (informational: XLA:CPU compiles a
+      per-stage backward program a ULP apart from the same jaxpr
+      inside one big program; the reduction/apply layers are bitwise
+      by construction — see parallel/overlap.py).
+    * ``int8`` — under HVD_TPU_COMPRESSION=int8 the monolithic static
+      path does not quantize at all, so the comparator is the
+      serialized schedule: same bucket partition ⇒ same pow2-scale
+      blocks, same stochastic-rounding ticks, same per-bucket
+      error-feedback residual keys ⇒ bitwise-identical params.
+
+    CPU-only like ``--mode control``: 8-virtual-device mesh, no TPU
+    tunnel.  ``HVD_TPU_BENCH_OVERLAP_QUICK=1`` (set by the supervised
+    run's child invocation) shrinks the chain and the timed blocks —
+    compile time dominates the full-size run, and the supervised JSON
+    carries these numbers for context while the CI `overlap-bench` job
+    owns the full-size gates.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.transformer import (
+        TransformerConfig, chained_lm_loss, chained_lm_params,
+        init_transformer, synthetic_lm_batch)
+    from horovod_tpu.parallel.training import (barrier_fence,
+                                               make_train_step, shard_batch)
+
+    quick = os.environ.get("HVD_TPU_BENCH_OVERLAP_QUICK") == "1"
+    layers, blocks = (2, 1) if quick else (4, 3)
+    if quick:
+        steps, seq = 6, 32
+    hvd.init(devices=jax.devices())
+    try:
+        n = hvd.size()
+        cfg = TransformerConfig(vocab_size=128, d_model=64, n_heads=4,
+                                n_layers=layers, d_ff=256,
+                                max_seq_len=seq)
+        chain = chained_lm_loss(cfg)
+
+        def plain_loss(p, b):  # not a ChainedLoss ⇒ unsegmented schedule
+            return chain(p, b)
+
+        key = jax.random.PRNGKey(0)
+        params0 = chained_lm_params(init_transformer(key, cfg), cfg)
+        tokens, targets = synthetic_lm_batch(jax.random.PRNGKey(1),
+                                             batch_per * n, seq,
+                                             cfg.vocab_size)
+        batch = shard_batch((jnp.asarray(tokens), jnp.asarray(targets)))
+        opt = optax.adam(1e-3)
+        # Threshold sized so each decoder layer splits into several
+        # dispatch buckets — the granularity the overlap streams at.
+        threshold = 16 * 1024
+
+        def build(mode, loss=chain):
+            return make_train_step(loss, opt, donate=False,
+                                   fusion_threshold=threshold,
+                                   overlap=mode)
+
+        def run(step, n_steps, wu=warmup):
+            p, s = params0, opt.init(params0)
+            for _ in range(wu):
+                p, s, loss = step(p, s, batch)
+            barrier_fence(p, loss)
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                p, s, loss = step(p, s, batch)
+            barrier_fence(p, loss)
+            return p, time.perf_counter() - t0
+
+        def identical(a, b):
+            return all(
+                np.asarray(x).tobytes() == np.asarray(y).tobytes()
+                for x, y in zip(jax.tree_util.tree_leaves(a),
+                                jax.tree_util.tree_leaves(b)))
+
+        # Identity legs first (short, untimed).
+        step_on = build("on")
+        step_serial = build("serial")
+        step_off = build("off")
+        params_on, _ = run(step_on, 2, wu=2)
+        params_serial, _ = run(step_serial, 2, wu=2)
+        params_off, _ = run(step_off, 2, wu=2)
+        params_u_on, _ = run(build("on", plain_loss), 2, wu=2)
+        params_u_off, _ = run(build("off", plain_loss), 2, wu=2)
+
+        bitwise = identical(params_u_on, params_u_off)
+        serial_eq = identical(params_on, params_serial)
+        seg_bitwise = identical(params_on, params_off)
+        seg_close = all(np.allclose(np.asarray(a), np.asarray(b),
+                                    rtol=1e-4, atol=1e-5)
+                        for a, b in zip(
+                            jax.tree_util.tree_leaves(params_on),
+                            jax.tree_util.tree_leaves(params_off)))
+
+        # Timed legs: alternating blocks, median per leg (background
+        # load hits both symmetrically — same policy as the dataplane
+        # bench's paired cycles).
+        rates = {"on": [], "serial": [], "off": []}
+        for _ in range(blocks):
+            for mode, step in (("on", step_on), ("serial", step_serial),
+                               ("off", step_off)):
+                _, dt = run(step, steps, wu=1)
+                rates[mode].append(steps / dt)
+
+        def median(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        on_rate = median(rates["on"])
+        serial_rate = median(rates["serial"])
+        off_rate = median(rates["off"])
+
+        # Quantized leg: per-bucket EF residuals must survive the
+        # refactor — overlapped ≡ serialized bitwise under int8.
+        hvd.set_compression(default="int8")
+        try:
+            p8_on, dt8_on = run(build("on"), 4, wu=2)
+            p8_serial, _ = run(build("serial"), 4, wu=2)
+            int8 = {
+                "bitwise_identical": identical(p8_on, p8_serial),
+                "quantized_active": not identical(p8_on, params_on),
+                "overlapped_steps_per_sec": round(4 / dt8_on, 2),
+            }
+        finally:
+            hvd.set_compression(default="none")
+
+        snap = hvd.metrics()
+        exposed = snap.get("overlap.exposed_comm_seconds", {})
+        return {
+            "metric": "overlap_steps_per_sec",
+            "value": round(on_rate, 2),
+            "unit": "steps/sec",
+            "overlapped": round(on_rate, 2),
+            "serialized": round(serial_rate, 2),
+            "monolithic": round(off_rate, 2),
+            "speedup": round(on_rate / serial_rate, 2) if serial_rate
+            else None,
+            "vs_monolithic": round(on_rate / off_rate, 2) if off_rate
+            else None,
+            "vs_baseline": round(on_rate / serial_rate, 2) if serial_rate
+            else None,
+            "bitwise_identical": bitwise,
+            "serial_identical": serial_eq,
+            "segmented_bitwise": seg_bitwise,
+            "segmented_close": seg_close,
+            "int8": int8,
+            "buckets": step_on.bucket_count,
+            "segments": step_on.segment_count,
+            "steps": steps,
+            "replicas": n,
+            "telemetry": {
+                "buckets_dispatched": snap.get(
+                    "overlap.buckets_dispatched", {}).get("value"),
+                "exposed_comm_seconds_sum": round(
+                    exposed.get("sum", 0.0), 4),
+                "fallbacks": snap.get(
+                    "overlap.fallbacks", {}).get("value", 0),
+            },
+        }
+    finally:
+        hvd.shutdown()
+
+
 def _serving_bench(n_requests: int = 40, max_slots: int = 8,
                    seed: int = 7) -> dict:
     """Serving microbench (``--mode serving``): tokens/sec through the
@@ -920,7 +1129,7 @@ def main() -> int:
                     help="tiny shapes for CPU sanity checks")
     ap.add_argument("--mode",
                     choices=["resnet", "control", "dataplane", "input",
-                             "serving"],
+                             "serving", "overlap"],
                     default="resnet",
                     help="control = control-plane negotiations/sec only "
                          "(no XLA, no TPU tunnel); dataplane = "
@@ -931,7 +1140,11 @@ def main() -> int:
                          "loader, prefetch+async on vs off (no TPU "
                          "tunnel); serving = hvd-serve tokens/sec, "
                          "continuous vs static batching on a seeded "
-                         "ragged-arrival trace (no TPU tunnel)")
+                         "ragged-arrival trace (no TPU tunnel); overlap "
+                         "= backward/communication overlap steps/sec, "
+                         "streamed vs serialized bucket dispatch on a "
+                         "transformer-LM chain, plus the bitwise "
+                         "param-identity gates (no TPU tunnel)")
     ap.add_argument("--check-speedup", type=float, default=None,
                     help="control mode: exit nonzero when the cache-on/"
                          "cache-off speedup is below this bound; "
@@ -945,7 +1158,12 @@ def main() -> int:
                          "static tokens/sec is below this bound OR the "
                          "two schedulers' completions differ OR the "
                          "engine rollout is not bitwise-equal to the "
-                         "non-incremental forward (CI gates)")
+                         "non-incremental forward (CI gates); overlap "
+                         "mode: exit nonzero when overlapped/serialized "
+                         "steps/sec is below this bound OR any bitwise "
+                         "param-identity gate fails (full-precision vs "
+                         "the monolithic step, int8 vs the serialized "
+                         "schedule)")
     ap.add_argument("--check-wire-ratio", type=float, default=None,
                     help="dataplane mode: exit nonzero when the int8 "
                          "bytes-on-wire compression ratio is below this "
@@ -1081,6 +1299,53 @@ def main() -> int:
             if not result.get("params_identical"):
                 failures.append("trained params differ between prefetch "
                                 "on and off")
+            if failures:
+                for f in failures:
+                    print(f"FAIL: {f}", file=sys.stderr)
+                return 1
+        return 0
+
+    if args.mode == "overlap":
+        # CPU-only like --mode dataplane: pin the 8-virtual-device mesh
+        # before the first jax import (same bootstrap as conftest.py).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        result = _overlap_bench()
+        print(json.dumps(result))
+        if args.check_speedup is not None:
+            failures = []
+            if (result.get("speedup") or 0.0) < args.check_speedup:
+                failures.append(
+                    f"overlap speedup {result.get('speedup')}x (streamed "
+                    f"vs serialized dispatch) < required "
+                    f"{args.check_speedup}x")
+            if not result.get("bitwise_identical"):
+                failures.append(
+                    "overlapped params not bitwise-identical to the "
+                    "monolithic step")
+            if not result.get("serial_identical"):
+                failures.append(
+                    "overlapped params not bitwise-identical to the "
+                    "serialized schedule")
+            if not result.get("segmented_close"):
+                failures.append(
+                    "segmented overlapped params diverge from the "
+                    "monolithic step beyond float tolerance")
+            int8 = result.get("int8") or {}
+            if not int8.get("bitwise_identical"):
+                failures.append(
+                    "int8 overlapped params not bitwise-identical to "
+                    "the int8 serialized schedule (per-bucket EF "
+                    "residuals broken)")
+            if not int8.get("quantized_active"):
+                failures.append(
+                    "int8 leg produced the full-precision params — the "
+                    "quantized wire path never engaged")
             if failures:
                 for f in failures:
                     print(f"FAIL: {f}", file=sys.stderr)
@@ -1265,13 +1530,24 @@ def _serving_or_error(timeout: float = 240.0) -> dict:
     return _child_bench_or_error("serving", timeout)
 
 
+def _overlap_or_error(timeout: float = 240.0) -> dict:
+    # The supervised child runs the quick shape (smaller chain, one
+    # timed block): its numbers ride the round JSON for context; the
+    # full-size identity + throughput gates live in CI (overlap-bench).
+    os.environ["HVD_TPU_BENCH_OVERLAP_QUICK"] = "1"
+    try:
+        return _child_bench_or_error("overlap", timeout)
+    finally:
+        os.environ.pop("HVD_TPU_BENCH_OVERLAP_QUICK", None)
+
+
 def _fail_json(error: str, attempts: int, attempt_log=None,
                control=None, dataplane=None, inputpipe=None,
-               serving=None) -> int:
+               serving=None, overlap=None) -> int:
     """Persistent failure: one parseable JSON line, not a traceback.
-    The control-, data-plane, input-pipeline and serving numbers still
-    ride along — none can be taken down by the tunnel, so every round
-    records at least those."""
+    The control-, data-plane, input-pipeline, serving and overlap
+    numbers still ride along — none can be taken down by the tunnel, so
+    every round records at least those."""
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
         "value": None,
@@ -1288,6 +1564,8 @@ def _fail_json(error: str, attempts: int, attempt_log=None,
         else _input_or_error(),
         "serving": serving if serving is not None
         else _serving_or_error(),
+        "overlap": overlap if overlap is not None
+        else _overlap_or_error(),
     }))
     return 1
 
@@ -1316,13 +1594,14 @@ def _supervise(args) -> int:
     deadline = time.monotonic() + args.total_budget
     t_start = time.monotonic()
     attempt_log = []
-    # Control-, data-plane, input-pipeline and serving microbenches
-    # first: host/CPU-only, tunnel-immune — whatever happens to the TPU
-    # below, this round records all four.
+    # Control-, data-plane, input-pipeline, serving and overlap
+    # microbenches first: host/CPU-only, tunnel-immune — whatever
+    # happens to the TPU below, this round records all five.
     control = _control_or_error()
     dataplane = _dataplane_or_error()
     inputpipe = _input_or_error()
     serving = _serving_or_error()
+    overlap = _overlap_or_error()
 
     def remaining() -> float:
         return deadline - time.monotonic()
@@ -1382,7 +1661,8 @@ def _supervise(args) -> int:
             f"tunnel probe failed {probe_n}x over "
             f"{time.monotonic() - t_start:.0f}s (TPU tunnel down/hung?)",
             attempts=0, attempt_log=attempt_log, control=control,
-            dataplane=dataplane, inputpipe=inputpipe, serving=serving)
+            dataplane=dataplane, inputpipe=inputpipe, serving=serving,
+            overlap=overlap)
 
     # Phase 1 — measurement attempts, each clamped to remaining budget.
     last_err = "unknown"
@@ -1424,7 +1704,7 @@ def _supervise(args) -> int:
         return _fail_json(last_err, attempts=attempts_made,
                           attempt_log=attempt_log, control=control,
                           dataplane=dataplane, inputpipe=inputpipe,
-                          serving=serving)
+                          serving=serving, overlap=overlap)
 
     # Phase 2 — eager/dynamic-path smoke on the real chip (budget
     # permitting).  Failure is reported, not fatal: the headline number
@@ -1446,6 +1726,7 @@ def _supervise(args) -> int:
     payload["data_plane"] = dataplane
     payload["input_pipeline"] = inputpipe
     payload["serving"] = serving
+    payload["overlap"] = overlap
     payload["attempt_log"] = attempt_log
     print(json.dumps(payload))
     return 0

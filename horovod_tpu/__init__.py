@@ -104,6 +104,7 @@ from .parallel.data import (  # noqa: F401
     broadcast_parameters,
 )
 from .parallel.input import prefetch_to_device  # noqa: F401
+from .parallel.overlap import ChainedLoss  # noqa: F401
 from .parallel.training import barrier_fence  # noqa: F401
 from . import elastic  # noqa: F401  (hvd.elastic.State / @hvd.elastic.run)
 from . import analysis  # noqa: F401  (hvd.analysis.verify_program & co)

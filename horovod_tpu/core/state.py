@@ -178,10 +178,12 @@ def init(devices=None) -> None:
     # control-plane HELLO handshake — ops/transport.py warns naming the
     # rank and the divergent knobs.)
     from ..ops import compression as _compression_env
+    from ..parallel import overlap as _overlap_env
     from . import topology as _topology_env
 
     _compression_env.validate_env()
     _topology_env.validate_env()
+    _overlap_env.validate_env()
 
     # Bootstrap the process cluster BEFORE the first device enumeration
     # (≙ MPI_Init_thread before MPI_Comm_rank, operations.cc:1173-1181).

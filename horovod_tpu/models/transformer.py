@@ -367,6 +367,72 @@ def make_loss_fn(cfg: TransformerConfig, ax: ParallelAxes = ParallelAxes(),
     return loss_fn
 
 
+def chained_lm_loss(cfg: TransformerConfig):
+    """The transformer LM as a :class:`~..parallel.overlap.ChainedLoss`
+    — the segmentable form the backward/communication-overlap step
+    streams gradient buckets out of (one backward program per stage:
+    embedding, each decoder layer, final-LN+unembed+cross-entropy).
+
+    Single-axis data parallelism with dense FFN layers only (the 5-way
+    parallel composition keeps :func:`make_loss_fn`; pipeline/expert
+    axes have their own schedules).  Calling the returned object
+    evaluates the identical monolithic loss, so ``HVD_TPU_OVERLAP=off``
+    differentiates the same math — the bitwise-identity contract of
+    ``bench.py --mode overlap``.  Pair with :func:`chained_lm_params`.
+    """
+    from ..parallel.overlap import ChainedLoss
+
+    if cfg.num_experts > 0:
+        raise ValueError("chained_lm_loss supports dense FFN layers only "
+                         "(num_experts == 0)")
+    ax = ParallelAxes()
+
+    def embed_stage(p, carry, batch):
+        tokens, _targets = batch
+        _b, s = tokens.shape
+        if s > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {s} exceeds cfg.max_seq_len "
+                f"{cfg.max_seq_len}; positions would clamp silently")
+        pos = jnp.arange(s)
+        return p["embed"][tokens] + jnp.take(p["pos_embed"], pos, axis=0)
+
+    def make_layer_stage():
+        def layer_stage(p, carry, batch):
+            x, _aux = _layer_fn(cfg)(carry, p, cfg, ax,
+                                     jnp.zeros((), jnp.float32))
+            return x
+        return layer_stage
+
+    def head_stage(p, carry, batch):
+        _tokens, targets = batch
+        x = _layernorm(carry, p["ln_f"]["scale"], p["ln_f"]["bias"])
+        logits = jnp.dot(x, p["unembed"],
+                         preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    stages = [embed_stage]
+    stages += [make_layer_stage() for _ in range(cfg.n_layers)]
+    stages.append(head_stage)
+    return ChainedLoss(stages)
+
+
+def chained_lm_params(params: dict, cfg: TransformerConfig) -> list:
+    """Restructure an :func:`init_transformer` tree into the per-stage
+    sequence :func:`chained_lm_loss` expects: ``[embed, layer_0, ...,
+    layer_{n-1}, head]`` (per-layer leaves unstacked from their leading
+    ``n_layers`` axis — each layer's gradients become their own overlap
+    dispatch segment)."""
+    out = [{"embed": params["embed"], "pos_embed": params["pos_embed"]}]
+    out += [_index_layer(params["layers"], i)
+            for i in range(cfg.n_layers)]
+    out.append({"ln_f": params["ln_f"], "unembed": params["unembed"]})
+    return out
+
+
 def synthetic_lm_batch(key, global_batch: int, seq_len: int,
                        vocab_size: int):
     """Synthetic next-token data (tokens, shifted targets)."""

@@ -2215,7 +2215,8 @@ def _check_reduce_op(red_op: ReduceOp, dtype, process_set=None) -> None:
 def _enqueue(x, op: RequestType, name: Optional[str],
              red_op: ReduceOp = ReduceOp.SUM,
              root_rank: int = -1, prefix: str = "",
-             process_set=None, splits: Tuple[int, ...] = ()) -> int:
+             process_set=None, splits: Tuple[int, ...] = (),
+             owned: Optional[bool] = None) -> int:
     _state._check_initialized()
     st = _state.global_state()
     if st.peer_shutdown:
@@ -2234,6 +2235,13 @@ def _enqueue(x, op: RequestType, name: Optional[str],
             f"{list(process_set.ranks)}) and cannot submit collectives "
             f"into it (the post-v0.13 process-set contract).")
     c = _classify(x, op, ps=process_set)
+    if owned is not None and not isinstance(c.value, (list, tuple)):
+        # Caller-declared ownership (donate_inputs=True): the submitter
+        # promises never to observe the array again, so the megakernel
+        # may donate it even though _classify saw a caller-held
+        # jax.Array.  The overlap path's gradient buffers ride this —
+        # they are step-internal producer outputs nothing else reads.
+        c.owned = bool(owned)
     if op == RequestType.ALLREDUCE:
         _check_reduce_op(red_op, c.dtype, process_set)
     name = name or _auto_name(prefix or op.name.lower(), process_set)
@@ -2292,19 +2300,26 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
 
 def grouped_allreduce_async(tensors, average=None,
                             name: Optional[str] = None,
-                            op=None) -> List[int]:
+                            op=None, donate_inputs: bool = False) -> List[int]:
     """Queue a group of allreduces in one call; returns one handle per
     tensor (≙ the post-v0.13 hvd.grouped_allreduce API).  The group
     enters the request queue back-to-back, so Tensor Fusion batches it
     — normally into one wire collective; a concurrent background tick
     can split a group across two fused responses, which changes wire
     batching, never results.  The default base name is unique per call
-    so overlapping anonymous groups never collide."""
+    so overlapping anonymous groups never collide.
+
+    ``donate_inputs=True`` declares the tensors executor-owned: the
+    caller promises never to observe them again, and the fused
+    megakernel donates their buffers (the backward/communication-overlap
+    step passes its gradient buffers this way — on TPU the reduction
+    then reuses the gradients' memory instead of allocating)."""
     base = name or _auto_name("grouped.allreduce")
     red_op = _resolve_op(average, op)
     return [
         _enqueue(t, RequestType.ALLREDUCE, f"{base}.{i}", red_op=red_op,
-                 prefix="allreduce")
+                 prefix="allreduce",
+                 owned=True if donate_inputs else None)
         for i, t in enumerate(tensors)
     ]
 
@@ -2666,6 +2681,41 @@ def synchronize(handle: int):
         st.handle_manager.synchronize(handle)
         raise err
     return st.handle_manager.synchronize(handle)
+
+
+def take_async(handle: int):
+    """Take a collective's result WITHOUT blocking on device completion.
+
+    :func:`synchronize` calls ``jax.block_until_ready`` — the right
+    contract for user code handing buffers to non-JAX consumers, but a
+    pipeline bubble for a consumer that immediately feeds the result
+    into another XLA program (the backward/communication-overlap step:
+    blocking on the reduced buckets before dispatching the optimizer
+    apply would serialize exactly the work the overlap hides).  This
+    variant drains until the op's kernel is *dispatched* and returns
+    the in-flight ``jax.Array`` future; XLA's per-device program order
+    guarantees the consumer reads it after the reduction wrote it.
+
+    Single-process only (the overlap path's mode); multi-process
+    callers get :func:`synchronize`'s full wait-with-withdraw
+    semantics.  Raises :class:`HorovodError` exactly like synchronize.
+    """
+    st = _state.global_state()
+    if st.multiprocess:
+        return synchronize(handle)
+    h = st.handle_manager._get(handle)
+    if h.result is None:
+        _drain()
+    if h.result is None:
+        raise HorovodError(
+            f"Collective {h.name} cannot complete: not all replica requests "
+            f"were submitted (it would stall).")
+    if isinstance(h.result, HorovodError):
+        err = h.result
+        h.result = ()  # release without re-running the finalizer
+        st.handle_manager.synchronize(handle)
+        raise err
+    return st.handle_manager.take(handle)
 
 
 def allreduce(tensor, average=None, name: Optional[str] = None, op=None,

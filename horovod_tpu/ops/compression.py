@@ -743,6 +743,11 @@ _SPMD_ENV_KNOBS = (
     BLOCK_ENV, ROUNDING_ENV, EF_ENV, SEED_ENV, MIN_ELEMS_ENV,
     "HVD_TPU_HIERARCHICAL", "HVD_TPU_VIRTUAL_SLICES",
     "HVD_TPU_MEGAKERNEL",
+    # Backward/communication overlap (parallel/overlap.py): selects
+    # which compiled programs a training step runs — monolithic vs
+    # bucketed sub-programs — so a rank diverging on it must be named
+    # at startup exactly like the compression/topology knobs.
+    "HVD_TPU_OVERLAP",
 )
 
 

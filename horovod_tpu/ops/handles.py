@@ -115,6 +115,24 @@ class HandleManager:
         _M_RELEASED.inc()
         return result
 
+    def take(self, handle: int) -> Any:
+        """Release the handle and return its (possibly still-computing)
+        result without blocking on device completion — the pipelined
+        variant behind ``collective.take_async`` (XLA async dispatch
+        owns the asynchrony; per-device program order protects
+        consumers that feed the future straight into another
+        program)."""
+        h = self._get(handle)
+        result = h.result
+        if h.finalizer is not None:
+            result = h.finalizer(result)
+        _native.handle_manager_mark_done(self._native, handle)
+        with self._lock:
+            del self._handles[handle]
+        _native.handle_manager_release(self._native, handle)
+        _M_RELEASED.inc()
+        return result
+
     def live_count(self) -> int:
         with self._lock:
             return len(self._handles)

@@ -135,11 +135,14 @@ def _recv_frame(sock: socket.socket):
 
 def _check_env_fingerprint(rank: int, payload: bytes, offset: int) -> None:
     """Cross-rank uniformity check of the SPMD-program-selecting env
-    knobs (compression/quantization/hierarchy — see
+    knobs (compression/quantization/hierarchy/overlap — see
     ops/compression.env_fingerprint): the worker's HELLO carries its
     fingerprint; a divergence from the controller's means the ranks
     would compile DIFFERENT collective programs — silent garbage or a
-    hang — so warn AT INIT naming the rank and every divergent knob."""
+    hang — so warn AT INIT naming the rank and every divergent knob.
+    ``HVD_TPU_OVERLAP`` rides the same fingerprint: a rank running the
+    bucketed-backward schedule against monolithic peers would submit a
+    per-bucket collective program the others never produce."""
     from . import compression as _compression
 
     if len(payload) < offset + 2:

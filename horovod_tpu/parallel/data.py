@@ -80,6 +80,49 @@ def _fusion_threshold_bytes() -> int:
     return int(os.environ.get("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024))
 
 
+def partition_fusion_buckets(leaves, threshold: int):
+    """Greedy Tensor-Fusion partition of a flat leaf list.
+
+    Group by dtype in first-appearance order, then pack each dtype's
+    leaves — in order — into buckets of at most ``threshold`` bytes (a
+    leaf bigger than the threshold alone forms its own bucket; a
+    threshold <= 0 disables fusion, one bucket per leaf).  ``leaves``
+    may be arrays or aval-likes (anything with ``shape``/``dtype``).
+    Returns a list of index lists covering every leaf exactly once.
+
+    This is THE partition rule of the repo: the static path's wire
+    packing below, the coordinator's fusion planning over one
+    submission window (``ops/cache.plan_fusion`` reproduces it for the
+    tensors a single drain tick sees) and the overlap path's
+    dispatch-boundary planning (``parallel/overlap.py``) all derive
+    from it — keeping them identical is what makes the overlapped
+    step's per-bucket quantized reduction bitwise-comparable to a
+    serialized dispatch of the same buckets (same bucket partition ⇒
+    same pow2-scale blocks and error-feedback keys per bucket).
+    """
+    by_dtype: dict = {}
+    for i, g in enumerate(leaves):
+        by_dtype.setdefault(jnp.dtype(g.dtype), []).append(i)
+    buckets: list = []
+    for dtype, idxs in by_dtype.items():
+        itemsize = jnp.dtype(dtype).itemsize
+        bucket: list = []
+        bucket_bytes = 0
+        for i in idxs:
+            nbytes = int(np.prod(leaves[i].shape, dtype=np.int64)) \
+                * itemsize if leaves[i].shape else itemsize
+            if threshold <= 0 or (
+                    bucket and bucket_bytes + nbytes > threshold):
+                if bucket:
+                    buckets.append(bucket)
+                bucket, bucket_bytes = [], 0
+            bucket.append(i)
+            bucket_bytes += nbytes
+        if bucket:
+            buckets.append(bucket)
+    return buckets
+
+
 def _adasum_gradients(grads):
     """Whole-gradient Adasum inside the replica trace.
 
@@ -142,6 +185,15 @@ def allreduce_gradients(grads, average: bool = True,
     overlap the collectives.  A threshold of 0 disables fusion (one psum
     per tensor, reference docs/tensor-fusion.md).
 
+    The threshold is not only a wire-packing knob: under the overlap
+    mode (``HVD_TPU_OVERLAP``, docs/performance.md) the SAME partition
+    (:func:`partition_fusion_buckets`) sets the dispatch-boundary
+    granularity — each bucket becomes one megakernel launch streamed
+    out of the backward pass.  ``op=Adasum`` ignores the threshold (and
+    ``compression``) entirely: its dot products are defined on the
+    whole full-precision gradient, so it never buckets, never fuses and
+    never overlaps (see :func:`_adasum_gradients`).
+
     ``compression`` (a :class:`~horovod_tpu.ops.compression.Compressor`,
     e.g. ``hvd.Compression.bf16``) casts dense gradients down for the
     wire and restores the dtype after — sparse leaves already ship a
@@ -203,43 +255,30 @@ def allreduce_gradients(grads, average: bool = True,
         return jax.tree_util.tree_unflatten(treedef, red)
 
     # Bucket by dtype, preserving leaf order for unflatten.  Sparse leaves
-    # bypass bucketing (their payload is already minimal).
+    # bypass bucketing (their payload is already minimal).  The partition
+    # itself is the shared fusion rule (partition_fusion_buckets) so the
+    # overlap path's dispatch boundaries match the wire packing exactly.
     out: list = [None] * len(leaves)
-    by_dtype: dict = {}
+    dense: list = []
     for i, g in enumerate(leaves):
         if isinstance(g, IndexedSlices):
             out[i] = gather_sparse(g)
+        else:
+            dense.append(i)
+    for bucket_pos in partition_fusion_buckets(
+            [jnp.asarray(leaves[i]) for i in dense], threshold):
+        bucket = [dense[p] for p in bucket_pos]
+        if len(bucket) == 1:
+            i = bucket[0]
+            out[i] = jax.lax.psum(leaves[i], REPLICA_AXIS)
             continue
-        by_dtype.setdefault(jnp.asarray(g).dtype, []).append(i)
-    for dtype, idxs in by_dtype.items():
-        bucket: list = []
-        bucket_bytes = 0
-        itemsize = jnp.dtype(dtype).itemsize
-
-        def flush(bucket):
-            if not bucket:
-                return
-            if len(bucket) == 1:
-                i = bucket[0]
-                out[i] = jax.lax.psum(leaves[i], REPLICA_AXIS)
-                return
-            flat = jnp.concatenate(
-                [jnp.ravel(leaves[i]) for i in bucket])
-            red = jax.lax.psum(flat, REPLICA_AXIS)
-            off = 0
-            for i in bucket:
-                n = leaves[i].size
-                out[i] = red[off:off + n].reshape(leaves[i].shape)
-                off += n
-
-        for i in idxs:
-            nbytes = leaves[i].size * itemsize
-            if bucket and bucket_bytes + nbytes > threshold:
-                flush(bucket)
-                bucket, bucket_bytes = [], 0
-            bucket.append(i)
-            bucket_bytes += nbytes
-        flush(bucket)
+        flat = jnp.concatenate([jnp.ravel(leaves[i]) for i in bucket])
+        red = jax.lax.psum(flat, REPLICA_AXIS)
+        off = 0
+        for i in bucket:
+            n = leaves[i].size
+            out[i] = red[off:off + n].reshape(leaves[i].shape)
+            off += n
     out = [o if isinstance(g, IndexedSlices)
            else finish(compression.decompress(o, ctx))
            for o, g, ctx in zip(out, leaves, ctxs)]

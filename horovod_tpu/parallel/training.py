@@ -176,10 +176,20 @@ class _ThrottledStep:
 
 
 def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
-               has_aux, donate, has_state, op=None):
+               has_aux, donate, has_state, op=None, overlap=None):
     """Shared builder behind :func:`make_train_step` and
     :func:`make_train_step_with_state` — one place wires the reduction,
-    pmean placement, shard_map specs and donation for both variants."""
+    pmean placement, shard_map specs and donation for both variants.
+
+    ``overlap`` (default: the ``HVD_TPU_OVERLAP`` env knob) selects the
+    backward/communication-overlap schedule (parallel/overlap.py):
+    ``off`` keeps this monolithic single-program step; ``on``/``serial``
+    build the bucketed-backward path whose gradient buckets ride the
+    dynamic megakernel executor per bucket.
+    """
+    from . import overlap as _overlap
+    from .data import _resolve_grad_op
+
     mesh = mesh or _state.mesh()
 
     compression = None
@@ -192,6 +202,36 @@ def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
         compression = optimizer._compression
         optimizer = optimizer._inner
 
+    from ..ops.wire import ReduceOp
+
+    schedule = _overlap.resolve_mode(overlap, mesh)
+    red_op = _resolve_grad_op(average, op)
+    # Adasum never overlaps: its scale-insensitive combination is
+    # defined on the WHOLE gradient vector — there is no per-bucket
+    # decomposition to stream (see allreduce_gradients).
+    if schedule != "off" and red_op != ReduceOp.ADASUM:
+        inner_optimizer = optimizer
+
+        def fallback_builder():
+            return _build_static_step(loss_fn, inner_optimizer, mesh,
+                                      average, fusion_threshold, has_aux,
+                                      donate, has_state, op, compression)
+
+        step = _overlap.make_overlapped_step(
+            loss_fn, optimizer, mesh, red_op, fusion_threshold, has_aux,
+            donate, has_state, compression, stream=schedule == "stream",
+            fallback_builder=fallback_builder)
+        return _throttle_on_cpu(step, mesh)
+    return _build_static_step(loss_fn, optimizer, mesh, average,
+                              fusion_threshold, has_aux, donate,
+                              has_state, op, compression)
+
+
+def _build_static_step(loss_fn, optimizer, mesh, average, fusion_threshold,
+                       has_aux, donate, has_state, op, compression):
+    """The pre-overlap monolithic step: forward + backward + in-program
+    bucketed reduction + optimizer apply compiled as ONE SPMD program
+    (exactly what ``HVD_TPU_OVERLAP=off`` must restore)."""
     # The stateful loss returns (loss, new_state) — an aux output.
     grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux or has_state)
 
@@ -256,29 +296,42 @@ def make_train_step(
     has_aux: bool = False,
     donate: bool = True,
     op=None,
+    overlap: Optional[str] = None,
 ):
     """Build the jitted data-parallel train step.
 
     Args:
       loss_fn: ``loss_fn(params, batch) -> scalar`` (or ``(scalar, aux)``
         with ``has_aux=True``).  Called per replica on the local shard.
+        A :class:`~horovod_tpu.parallel.overlap.ChainedLoss` additionally
+        lets the overlap mode segment the backward pass per stage.
       optimizer: an optax ``GradientTransformation`` or a
         :class:`DistributedOptimizer` (unwrapped — its averaging flags are
         honored; reduction happens once, inside the replica context).
       mesh: replica mesh; defaults to the global one from ``init()``.
       average: average (True) or sum (False) gradients across replicas.
       fusion_threshold: Tensor-Fusion bucket size in bytes; defaults to
-        ``HOROVOD_FUSION_THRESHOLD`` (64 MB).
+        ``HOROVOD_FUSION_THRESHOLD`` (64 MB).  This is more than a
+        wire-packing knob: under the overlap mode the SAME partition
+        sets the dispatch-boundary granularity (each bucket = one
+        megakernel streamed out of the backward pass,
+        docs/performance.md).  ``op=Adasum`` ignores it entirely — the
+        whole-gradient combination neither buckets nor overlaps.
       op: hvd.Average/Sum/Adasum (supersedes ``average``); Adasum compiles
         the whole-gradient ppermute ladder into the step.
+      overlap: backward/communication-overlap schedule —
+        ``auto``/``on``/``off``/``serial``; defaults to the
+        ``HVD_TPU_OVERLAP`` env knob (parallel/overlap.py).
 
     Returns:
       ``step(params, opt_state, batch) -> (params, opt_state, loss[, aux])``
-      — one compiled SPMD program; batch's leading axis must be divisible by
-      the replica count.
+      — one compiled SPMD program (overlap off), or the bucketed-backward
+      sub-program pipeline with bitwise-identical results (overlap on);
+      batch's leading axis must be divisible by the replica count.
     """
     return _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
-                      has_aux, donate, has_state=False, op=op)
+                      has_aux, donate, has_state=False, op=op,
+                      overlap=overlap)
 
 
 def make_train_step_with_state(
@@ -289,17 +342,21 @@ def make_train_step_with_state(
     fusion_threshold: Optional[int] = None,
     donate: bool = True,
     op=None,
+    overlap: Optional[str] = None,
 ):
     """Train-step builder for models carrying non-trained state (BatchNorm
     statistics): ``loss_fn(params, model_state, batch) -> (loss, new_state)``;
     the updated statistics are ``pmean``-ed every step (synchronized
-    BatchNorm).
+    BatchNorm).  ``fusion_threshold`` and ``overlap`` behave exactly as
+    in :func:`make_train_step` (the stateful variant overlaps through
+    the single-backward streaming schedule).
 
     Returns ``step(params, model_state, opt_state, batch) ->
     (params, model_state, opt_state, loss)``.
     """
     return _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
-                      has_aux=False, donate=donate, has_state=True, op=op)
+                      has_aux=False, donate=donate, has_state=True, op=op,
+                      overlap=overlap)
 
 
 def make_parallel_train_step(loss_fn: Callable[..., Any], optimizer,

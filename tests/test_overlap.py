@@ -1,0 +1,475 @@
+"""Backward/communication overlap (parallel/overlap.py, ISSUE 8).
+
+The bucketed-backward train step streams each gradient bucket's
+pack→reduce→unpack megakernel out of the backward pass instead of
+waiting for the full gradient pytree.  Its load-bearing contracts:
+
+* bitwise identity: the overlapped step's parameters equal the
+  monolithic ``HVD_TPU_OVERLAP=off`` step's, bitwise, for the
+  single-backward streaming schedule, across leaf dtypes; the
+  segmented schedule equals the serialized dispatch of the same
+  sub-programs bitwise (same programs, different interleaving);
+* steady state: exactly one megakernel launch per bucket per cycle,
+  with the response cache replaying every bucket's sub-program (no
+  renegotiation after warmup) — counted at jax's real dispatch choke
+  point (utils/xla_dispatch, same policy as tests/test_megakernel.py);
+* per-bucket error-feedback residuals survive the partial-cycle
+  refactor (int8 wire: overlapped ≡ serialized bitwise across steps);
+* a fusion-threshold change re-partitions the dispatch boundaries
+  (the same event that flushes the coordinator plan memo);
+* unbucketable trees (sparse IndexedSlices leaves, Adasum, subset
+  meshes) fall back to the monolithic step.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu.core.state as state_mod
+import horovod_tpu.ops.megakernel as mk
+from horovod_tpu.core.state import REPLICA_AXIS
+from horovod_tpu.ops import compression as compression_mod
+from horovod_tpu.ops.sparse import IndexedSlices
+from horovod_tpu.parallel import overlap as OV
+from horovod_tpu.parallel.training import make_train_step, shard_batch
+
+# ---------------------------------------------------------------------------
+# Fixtures: a plain loss (unsegmented schedule) and a 3-stage chain
+# (segmented schedule), sized so each segment splits into two buckets
+# at _THRESHOLD (b-leaves bucket apart from the w-leaves).
+# ---------------------------------------------------------------------------
+
+_DIM = 64
+_THRESHOLD = _DIM * _DIM * 4  # one f32 [64, 64] weight fills a bucket
+
+
+def _plain_loss(params, batch):
+    x, y = batch
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    pred = h @ params["w2"] + params["b2"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _plain_params(key, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    s = 1.0 / np.sqrt(_DIM)
+    return {
+        "w1": (jax.random.normal(k1, (_DIM, _DIM)) * s).astype(dtype),
+        "b1": jnp.zeros((_DIM,), dtype),
+        "w2": (jax.random.normal(k2, (_DIM, _DIM)) * s).astype(dtype),
+        "b2": jnp.zeros((_DIM,), dtype),
+    }
+
+
+def _chain():
+    def stage0(p, carry, batch):
+        x, _y = batch
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def stage1(p, carry, batch):
+        return jnp.tanh(carry @ p["w"] + p["b"])
+
+    def stage2(p, carry, batch):
+        _x, y = batch
+        pred = carry @ p["w"] + p["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    return OV.ChainedLoss([stage0, stage1, stage2])
+
+
+def _chain_params(key):
+    ks = jax.random.split(key, 3)
+    s = 1.0 / np.sqrt(_DIM)
+    return [{"w": jax.random.normal(k, (_DIM, _DIM)) * s,
+             "b": jnp.zeros((_DIM,))} for k in ks]
+
+
+def _batch(hvd, key, per=4):
+    n = hvd.size()
+    kx, ky = jax.random.split(key)
+    x = jax.random.normal(kx, (per * n, _DIM))
+    y = jax.random.normal(ky, (per * n, _DIM))
+    return shard_batch((x, y))
+
+
+def _leaves_equal(a, b):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    return all(np.asarray(x).tobytes() == np.asarray(y).tobytes()
+               for x, y in zip(fa, fb))
+
+
+def _run(step, params, opt, batch, steps):
+    p, s = params, opt.init(params)
+    loss = None
+    for _ in range(steps):
+        out = step(p, s, batch)
+        p, s, loss = out[0], out[1], out[2]
+    jax.block_until_ready(jax.tree_util.tree_leaves(p))
+    return p, float(loss)
+
+
+# ---------------------------------------------------------------------------
+# Bitwise identity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_stream_bitwise_identical_to_monolithic(hvd, dtype):
+    """The streaming schedule's params ≡ the monolithic step's, bitwise,
+    after several steps — per leaf dtype (buckets partition by wire
+    dtype, so each dtype rides its own megakernels)."""
+    params = _plain_params(jax.random.PRNGKey(0), dtype)
+    batch = _batch(hvd, jax.random.PRNGKey(1))
+    opt = optax.adam(1e-3)
+    p_on, l_on = _run(make_train_step(
+        _plain_loss, opt, donate=False, fusion_threshold=_THRESHOLD,
+        overlap="on"), params, opt, batch, 3)
+    p_off, l_off = _run(make_train_step(
+        _plain_loss, opt, donate=False, fusion_threshold=_THRESHOLD,
+        overlap="off"), params, opt, batch, 3)
+    assert l_on == l_off
+    assert _leaves_equal(p_on, p_off)
+
+
+def test_stream_bitwise_identical_mixed_dtypes(hvd):
+    """One tree mixing f32 and bf16 leaves: the bucket plan groups by
+    dtype and the result stays bitwise vs the monolithic step."""
+    params = _plain_params(jax.random.PRNGKey(0))
+    params["b1"] = params["b1"].astype(jnp.bfloat16)
+    params["b2"] = params["b2"].astype(jnp.bfloat16)
+    batch = _batch(hvd, jax.random.PRNGKey(1))
+    opt = optax.sgd(0.1)
+    p_on, _ = _run(make_train_step(
+        _plain_loss, opt, donate=False, fusion_threshold=_THRESHOLD,
+        overlap="on"), params, opt, batch, 2)
+    p_off, _ = _run(make_train_step(
+        _plain_loss, opt, donate=False, fusion_threshold=_THRESHOLD,
+        overlap="off"), params, opt, batch, 2)
+    assert _leaves_equal(p_on, p_off)
+
+
+def test_segmented_stream_equals_serialized_bitwise(hvd):
+    """ChainedLoss: the streamed dispatch ≡ the serialized dispatch of
+    the SAME per-bucket sub-programs, bitwise (structural — identical
+    programs, different interleaving), and ≈ the monolithic step
+    (XLA:CPU compiles per-stage backward programs a ULP apart from the
+    fused whole-program backward; see parallel/overlap.py)."""
+    chain = _chain()
+    params = _chain_params(jax.random.PRNGKey(0))
+    batch = _batch(hvd, jax.random.PRNGKey(1))
+    opt = optax.adam(1e-3)
+
+    def build(mode):
+        return make_train_step(chain, opt, donate=False,
+                               fusion_threshold=_THRESHOLD, overlap=mode)
+
+    step_on = build("on")
+    p_on, _ = _run(step_on, params, opt, batch, 3)
+    p_ser, _ = _run(build("serial"), params, opt, batch, 3)
+    p_off, _ = _run(build("off"), params, opt, batch, 3)
+    assert step_on.overlap_active
+    assert step_on.segment_count == 3
+    assert step_on.bucket_count == 6  # (w, b) buckets per stage
+    assert _leaves_equal(p_on, p_ser)
+    for a, b in zip(jax.tree_util.tree_leaves(p_on),
+                    jax.tree_util.tree_leaves(p_off)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_overlap_off_restores_static_step(hvd, monkeypatch):
+    """HVD_TPU_OVERLAP=off (and the pre-PR default on CPU meshes via
+    auto) builds the plain jitted program — no overlap machinery at
+    all."""
+    opt = optax.sgd(0.1)
+    step_off = make_train_step(_plain_loss, opt, donate=False,
+                               overlap="off")
+    assert not hasattr(step_off, "overlap_active")
+    monkeypatch.delenv(OV.OVERLAP_ENV, raising=False)
+    step_auto = make_train_step(_plain_loss, opt, donate=False)
+    assert not hasattr(step_auto, "overlap_active")  # auto→off on CPU
+
+
+# ---------------------------------------------------------------------------
+# Steady state: one launch per bucket, response-cache replay
+# ---------------------------------------------------------------------------
+
+def test_exactly_one_launch_per_bucket_and_cache_replay(hvd):
+    """After warmup, one training cycle issues exactly one megakernel
+    launch per bucket — counted at jax's dispatch choke point — and
+    every bucket's sub-program replays from the response cache (zero
+    new negotiations)."""
+    from horovod_tpu.utils import xla_dispatch
+
+    chain = _chain()
+    params = _chain_params(jax.random.PRNGKey(0))
+    batch = _batch(hvd, jax.random.PRNGKey(1))
+    opt = optax.sgd(0.1)
+    step = make_train_step(chain, opt, donate=False,
+                           fusion_threshold=_THRESHOLD, overlap="on")
+    mk.set_enabled(True)
+    p, s = params, opt.init(params)
+    for _ in range(2):  # cold + first warm cycle
+        p, s, _ = step(p, s, batch)
+    jax.block_until_ready(jax.tree_util.tree_leaves(p))
+
+    st = state_mod.global_state()
+    n_buckets = step.bucket_count
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    launches0 = mk.stats.launches
+    cache0 = st.response_cache.stats.replayed_tensors
+    misses0 = st.response_cache.stats.misses
+    with xla_dispatch.exact_scope():
+        with xla_dispatch.record(all_threads=True) as scope:
+            p, s, _ = step(p, s, batch)
+            jax.block_until_ready(jax.tree_util.tree_leaves(p))
+
+    assert mk.stats.launches - launches0 == n_buckets, (
+        f"steady-state cycle ran {mk.stats.launches - launches0} "
+        f"megakernel launches for {n_buckets} buckets")
+    # Choke-point accounting: 1 forward + one backward program per
+    # segment + one megakernel per bucket + 1 optimizer apply.  Any
+    # eager-op creep on the dispatch path breaks this equality.
+    expected = 1 + step.segment_count + n_buckets + 1
+    assert scope.count == expected, (
+        f"steady-state cycle issued {scope.count} XLA dispatches; "
+        f"expected {expected} (fwd + {step.segment_count} bwd + "
+        f"{n_buckets} megakernels + apply)")
+    # Replay bypassed negotiation for every bucket (per-bucket
+    # sub-programs are fully cache-hit: no new misses).
+    assert st.response_cache.stats.replayed_tensors - cache0 == n_leaves
+    assert st.response_cache.stats.misses == misses0
+
+
+def test_telemetry_counters_and_timeline_instants(hvd, tmp_path):
+    """overlap.buckets_dispatched counts every bucket handed to the
+    dynamic path; overlap.exposed_comm_seconds records the post-backward
+    completion wait; each dispatch writes a BUCKET_DISPATCH timeline
+    instant."""
+    import horovod_tpu as H
+
+    chain = _chain()
+    params = _chain_params(jax.random.PRNGKey(0))
+    batch = _batch(hvd, jax.random.PRNGKey(1))
+    opt = optax.sgd(0.1)
+    step = make_train_step(chain, opt, donate=False,
+                           fusion_threshold=_THRESHOLD, overlap="on")
+    base = H.metrics().get("overlap.buckets_dispatched", {}).get("value", 0)
+    tl_path = tmp_path / "overlap_timeline.json"
+    H.start_timeline(str(tl_path))
+    try:
+        _run(step, params, opt, batch, 2)
+    finally:
+        H.stop_timeline()
+    snap = H.metrics()
+    dispatched = snap["overlap.buckets_dispatched"]["value"] - base
+    assert dispatched == 2 * step.bucket_count
+    assert snap["overlap.exposed_comm_seconds"]["count"] >= 2
+    events = json.loads(tl_path.read_text())
+    if isinstance(events, dict):
+        events = events["traceEvents"]
+    instants = [e for e in events if e.get("name") == "BUCKET_DISPATCH"]
+    assert len(instants) == dispatched
+    assert {e["args"]["bucket"] for e in instants} \
+        == set(range(step.bucket_count))
+
+
+# ---------------------------------------------------------------------------
+# Quantized wire: per-bucket error-feedback residuals
+# ---------------------------------------------------------------------------
+
+def test_int8_ef_residuals_carry_over_per_bucket(hvd):
+    """Under int8 wire compression the streamed schedule stays bitwise
+    equal to the serialized schedule across steps — only true when each
+    bucket's error-feedback residual is stored and re-consumed under
+    its own (per-bucket sub-program) key, and the residual actually
+    carries: the quantized trajectory must diverge from full precision."""
+    import horovod_tpu as H
+
+    chain = _chain()
+    params = _chain_params(jax.random.PRNGKey(0))
+    batch = _batch(hvd, jax.random.PRNGKey(1))
+    opt = optax.adam(1e-3)
+
+    def build(mode):
+        return make_train_step(chain, opt, donate=False,
+                               fusion_threshold=_THRESHOLD, overlap=mode)
+
+    p_fp, _ = _run(build("on"), params, opt, batch, 3)
+    H.set_compression(default="int8")
+    try:
+        step_on = build("on")
+        p_on, _ = _run(step_on, params, opt, batch, 3)
+        p_ser, _ = _run(build("serial"), params, opt, batch, 3)
+        # One EF residual entry per bucket survives for the next step.
+        assert mk.residual_count() >= step_on.bucket_count
+    finally:
+        H.set_compression(default="none")
+    assert _leaves_equal(p_on, p_ser)
+    assert not _leaves_equal(p_on, p_fp)  # the wire really quantized
+
+
+# ---------------------------------------------------------------------------
+# Fusion-threshold flush
+# ---------------------------------------------------------------------------
+
+def test_fusion_threshold_change_replans_buckets(hvd):
+    """set_fusion_threshold mid-training (the autotune event that
+    flushes the coordinator plan memo and the megakernel cache) makes
+    the overlapped step re-partition its dispatch boundaries on the
+    next call — and the result stays bitwise vs the monolithic step."""
+    params = _plain_params(jax.random.PRNGKey(0))
+    batch = _batch(hvd, jax.random.PRNGKey(1))
+    opt = optax.sgd(0.1)
+    st = state_mod.global_state()
+    st.coordinator.set_fusion_threshold(_THRESHOLD)
+    try:
+        step = make_train_step(_plain_loss, opt, donate=False,
+                               overlap="on")
+        p, s = params, opt.init(params)
+        p, s, _ = step(p, s, batch)
+        coarse = step.bucket_count
+        # Below one bias leaf (256 B): every leaf becomes its own bucket.
+        st.coordinator.set_fusion_threshold(128)
+        p, s, _ = step(p, s, batch)
+        fine = step.bucket_count
+        assert fine > coarse, (coarse, fine)
+
+        # Same two-threshold trajectory on the monolithic step: the
+        # re-planned buckets still reduce to identical parameters.
+        st.coordinator.set_fusion_threshold(_THRESHOLD)
+        step_off = make_train_step(_plain_loss, opt, donate=False,
+                                   overlap="off")
+        q, t = params, opt.init(params)
+        q, t, _ = step_off(q, t, batch)
+        st.coordinator.set_fusion_threshold(128)
+        q, t, _ = step_off(q, t, batch)
+        assert _leaves_equal(p, q)
+    finally:
+        st.coordinator.set_fusion_threshold(64 * 1024 * 1024)
+
+
+# ---------------------------------------------------------------------------
+# Fallbacks: unbucketable trees keep the monolithic program
+# ---------------------------------------------------------------------------
+
+def test_sparse_gradient_leaves_fall_back(hvd):
+    """IndexedSlices gradient leaves ship a negotiated-size payload the
+    bucket planner cannot size: the trace-time probe refuses them."""
+    opt = optax.sgd(0.1)
+    step = make_train_step(_plain_loss, opt, donate=False, overlap="on")
+
+    def sparse_grad_fn(params, batch):
+        grads = dict(params)
+        grads["w1"] = IndexedSlices(jnp.zeros((2, _DIM)),
+                                    jnp.zeros((2,), jnp.int32),
+                                    (_DIM, _DIM))
+        return jnp.zeros(()), grads
+
+    with pytest.raises(OV._Unbucketable, match="sparse"):
+        step._detect_sparse(sparse_grad_fn,
+                            _plain_params(jax.random.PRNGKey(0)), None,
+                            _batch(hvd, jax.random.PRNGKey(1)))
+
+
+def test_adasum_never_overlaps(hvd):
+    """op=Adasum combines the WHOLE gradient vector — no per-bucket
+    decomposition exists, so the builder keeps the static step even
+    with overlap forced on."""
+    import horovod_tpu as H
+
+    opt = optax.sgd(0.1)
+    step = make_train_step(_plain_loss, opt, donate=False, op=H.Adasum,
+                           overlap="on")
+    assert not hasattr(step, "overlap_active")
+    params = _plain_params(jax.random.PRNGKey(0))
+    p, _, loss = step(params, opt.init(params),
+                      _batch(hvd, jax.random.PRNGKey(1)))
+    assert np.isfinite(float(loss))
+
+
+def test_subset_mesh_falls_back(hvd):
+    """A step built over a sub-mesh of the global replica set keeps its
+    in-program reduction (the dynamic path negotiates over ALL
+    replicas); results match the monolithic sub-mesh step bitwise."""
+    devices = jax.devices()[:4]
+    mesh = jax.sharding.Mesh(np.asarray(devices), (REPLICA_AXIS,))
+    params = _plain_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4 * len(devices), _DIM))
+    y = jax.random.normal(jax.random.PRNGKey(2), (4 * len(devices), _DIM))
+    opt = optax.sgd(0.1)
+    step = make_train_step(_plain_loss, opt, mesh=mesh, donate=False,
+                           overlap="on")
+    p_on, _ = _run(step, params, opt, (x, y), 2)
+    assert step.overlap_active is False  # fell back on first call
+    step_off = make_train_step(_plain_loss, opt, mesh=mesh, donate=False,
+                               overlap="off")
+    p_off, _ = _run(step_off, params, opt, (x, y), 2)
+    assert _leaves_equal(p_on, p_off)
+
+
+# ---------------------------------------------------------------------------
+# Env knob: validation, resolution, HELLO fingerprint
+# ---------------------------------------------------------------------------
+
+def test_env_knob_validation(monkeypatch):
+    monkeypatch.setenv(OV.OVERLAP_ENV, "bogus")
+    with pytest.raises(ValueError, match="HVD_TPU_OVERLAP"):
+        OV.validate_env()
+    for ok in ("auto", "on", "off", "serial", "1", "0", "ON", " off "):
+        monkeypatch.setenv(OV.OVERLAP_ENV, ok)
+        OV.validate_env()
+    monkeypatch.setenv(OV.OVERLAP_ENV, "1")
+    assert OV.overlap_mode() == "on"
+    monkeypatch.setenv(OV.OVERLAP_ENV, "0")
+    assert OV.overlap_mode() == "off"
+
+
+def test_init_rejects_malformed_overlap_env(monkeypatch):
+    """hvd.init() fails fast — not the first training step — on a
+    malformed knob, like the compression/topology knobs."""
+    import horovod_tpu as H
+
+    monkeypatch.setenv(OV.OVERLAP_ENV, "sideways")
+    with pytest.raises(ValueError, match="HVD_TPU_OVERLAP"):
+        H.init(devices=jax.devices())
+
+
+def test_auto_resolution_per_mesh_platform(monkeypatch):
+    """auto = streaming only on real multi-replica accelerator meshes;
+    CPU/virtual meshes keep the monolithic program (their shared thread
+    pool has no comm/compute concurrency to exploit)."""
+    from types import SimpleNamespace
+
+    monkeypatch.delenv(OV.OVERLAP_ENV, raising=False)
+    cpu_mesh = SimpleNamespace(devices=np.asarray(
+        [SimpleNamespace(platform="cpu")] * 8))
+    tpu_mesh = SimpleNamespace(devices=np.asarray(
+        [SimpleNamespace(platform="tpu")] * 8))
+    one_tpu = SimpleNamespace(devices=np.asarray(
+        [SimpleNamespace(platform="tpu")]))
+    assert OV.resolve_mode(None, cpu_mesh) == "off"
+    assert OV.resolve_mode(None, tpu_mesh) == "stream"
+    assert OV.resolve_mode(None, one_tpu) == "off"  # nothing to reduce
+    assert OV.resolve_mode("on", cpu_mesh) == "stream"  # explicit wins
+    assert OV.resolve_mode("serial", tpu_mesh) == "serial"
+    with pytest.raises(ValueError, match="overlap"):
+        OV.resolve_mode("diagonal", cpu_mesh)
+
+
+def test_overlap_knob_in_hello_env_fingerprint(monkeypatch):
+    """HVD_TPU_OVERLAP rides the HELLO env fingerprint: a rank
+    diverging on the overlap mode is named at startup like the
+    compression/topology knobs."""
+    assert "HVD_TPU_OVERLAP" in compression_mod._SPMD_ENV_KNOBS
+    monkeypatch.setenv(OV.OVERLAP_ENV, "on")
+    fp_on = compression_mod.env_fingerprint()
+    monkeypatch.setenv(OV.OVERLAP_ENV, "off")
+    fp_off = compression_mod.env_fingerprint()
+    assert fp_on != fp_off
