@@ -1,0 +1,123 @@
+"""hvd-chaos: deterministic fault injection at the runtime's real
+failure boundaries (docs/chaos.md).
+
+``HVD_TPU_FAULTS="<spec>@seed"`` arms a seeded
+:class:`~horovod_tpu.chaos.spec.FaultSchedule`; every hardened layer
+asks :func:`fire` at its failure boundary — the transport's frame
+send path, the coordinator drain tick, the background checkpoint
+writer's tmp-file write, the prefetch stager, the serving front door —
+and the schedule answers deterministically (same spec + seed ⇒ the
+identical fault sequence, the replay contract).
+
+The no-hang contract this enables (enforced by ``python -m
+horovod_tpu.chaos --matrix``, CI job ``chaos``): under every schedule
+in the scenario matrix the fleet either fully recovers — results
+bitwise-identical to the fault-free run — or fails within a bounded
+time with a diagnostic naming the injected fault.  A hang is a test
+failure.
+
+Hot-path cost when unarmed: one module-global ``None`` check per
+injection point (the schedule loads lazily from the env on first use;
+:func:`reload` re-reads it for tests).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .. import telemetry as _telemetry
+from ..telemetry import flight as _flight
+from .spec import Fault, FaultSchedule, VALID_SITES, parse  # noqa: F401
+
+_M_INJECTED = _telemetry.counter(
+    "chaos.injected", "faults fired by the hvd-chaos schedule")
+
+# None = unarmed (the overwhelmingly common case); loaded lazily.
+_schedule: Optional[FaultSchedule] = None
+_loaded = False
+_rank: Optional[int] = None
+
+
+def validate_env() -> None:
+    """Fail-at-init validation of HVD_TPU_FAULTS (core/state.init):
+    a typo'd site/key must abort with the valid list, not surface as a
+    silent no-op chaos run."""
+    spec = os.environ.get("HVD_TPU_FAULTS")
+    if spec:
+        parse(spec)
+
+
+def reload() -> Optional[FaultSchedule]:
+    """(Re-)load the schedule from the env — tests repoint
+    HVD_TPU_FAULTS mid-process."""
+    global _schedule, _loaded, _rank
+    spec = os.environ.get("HVD_TPU_FAULTS")
+    _schedule = parse(spec) if spec else None
+    _loaded = True
+    _rank = None
+    if _schedule is not None and _schedule.sites():
+        print(f"[hvd-chaos] armed: {_schedule.describe()}",
+              file=sys.stderr)
+    return _schedule
+
+
+def schedule() -> Optional[FaultSchedule]:
+    if not _loaded:
+        reload()
+    return _schedule
+
+
+def active() -> bool:
+    return schedule() is not None
+
+
+def _rank_of() -> int:
+    """Best-effort rank for rank-filtered clauses (cached; same lazy
+    resolution as the flight recorder's)."""
+    global _rank
+    if _rank is None:
+        _rank = _flight._rank_of()
+    return _rank
+
+
+def fire(site: str) -> Optional[Fault]:
+    """Account one opportunity at ``site``; returns the
+    :class:`Fault` when this opportunity fires.  Every firing is
+    logged with its clause + opportunity index — the exact line a
+    replay needs — flight-recorded, and counted
+    (``chaos.injected``)."""
+    sched = _schedule if _loaded else schedule()
+    if sched is None:
+        return None
+    f = sched.fire(site, rank=_rank_of())
+    if f is not None:
+        _M_INJECTED.inc()
+        _flight.record("chaos", f.site, f.n, f.clause)
+        print(f"[hvd-chaos] rank {_rank_of()}: fired {f.site}#{f.n} "
+              f"(clause {f.clause!r}, seed {sched.seed})",
+              file=sys.stderr)
+    return f
+
+
+def sleep_site(site: str) -> bool:
+    """Convenience for the pure-delay sites (coord.tick_delay,
+    input.stall, transport.delay): sleep the clause's delay when the
+    opportunity fires.  Returns whether it fired."""
+    f = fire(site)
+    if f is None:
+        return False
+    time.sleep(f.delay)
+    return True
+
+
+def maybe_reorder(site: str, items: List) -> List:
+    """coord.reorder: deterministically permute ``items`` (reverse —
+    pure in the firing decision) when the opportunity fires.  The
+    caller scopes this to a reorder-legal span (freshly negotiated
+    responses within one tick; never across a CACHE_FLUSH marker)."""
+    if len(items) > 1 and fire(site) is not None:
+        return list(reversed(items))
+    return items

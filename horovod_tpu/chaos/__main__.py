@@ -1,0 +1,69 @@
+"""CLI for hvd-chaos (docs/chaos.md).
+
+  python -m horovod_tpu.chaos --matrix            run the full no-hang
+                                                  scenario matrix (CI
+                                                  job ``chaos``)
+  python -m horovod_tpu.chaos --matrix --only A B run a subset
+  python -m horovod_tpu.chaos --list              print the matrix
+  python -m horovod_tpu.chaos --scenario NAME     (child) one local
+                                                  scenario in THIS
+                                                  process
+  python -m horovod_tpu.chaos --node R --np N \\
+      --port P --scenario NAME                    (child) one rank of a
+                                                  control-plane fleet
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import matrix as _matrix
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m horovod_tpu.chaos")
+    ap.add_argument("--matrix", action="store_true",
+                    help="run the no-hang scenario matrix")
+    ap.add_argument("--only", nargs="*", default=None,
+                    help="scenario subset for --matrix")
+    ap.add_argument("--list", action="store_true",
+                    help="list the matrix scenarios")
+    ap.add_argument("--scenario", default=None,
+                    help="(child) scenario name")
+    ap.add_argument("--node", type=int, default=None,
+                    help="(child) control-plane fleet rank")
+    ap.add_argument("--np", type=int, default=2,
+                    help="(child) control-plane fleet size")
+    ap.add_argument("--port", type=int, default=0,
+                    help="(child) controller port")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for s in _matrix.SCENARIOS:
+            print(f"{s.name:26s} {s.kind:5s} expect={s.expect:10s} "
+                  f"cap={s.cap:.0f}s spec={s.spec!r}")
+        return 0
+    if args.matrix:
+        return _matrix.run_matrix(only=args.only, verbose=args.verbose)
+    if args.node is not None:
+        if args.node == 0:
+            _matrix.run_cp_controller(args.np, args.port)
+        else:
+            _matrix.run_cp_worker(args.node, args.port)
+        return 0
+    if args.scenario:
+        fn = _matrix.LOCAL_SCENARIOS.get(args.scenario)
+        if fn is None:
+            print(f"unknown local scenario {args.scenario!r}",
+                  file=sys.stderr)
+            return 2
+        fn()
+        return 0
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

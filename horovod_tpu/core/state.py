@@ -177,6 +177,7 @@ def init(devices=None) -> None:
     # (Cross-rank uniformity of the same knobs is checked by the
     # control-plane HELLO handshake — ops/transport.py warns naming the
     # rank and the divergent knobs.)
+    from .. import chaos as _chaos_env
     from ..ops import compression as _compression_env
     from ..parallel import overlap as _overlap_env
     from . import topology as _topology_env
@@ -184,6 +185,10 @@ def init(devices=None) -> None:
     _compression_env.validate_env()
     _topology_env.validate_env()
     _overlap_env.validate_env()
+    # hvd-chaos: a typo'd HVD_TPU_FAULTS clause must abort init with
+    # the valid site/key list, not silently run a fault-free "chaos"
+    # job (docs/chaos.md).
+    _chaos_env.validate_env()
 
     # Bootstrap the process cluster BEFORE the first device enumeration
     # (≙ MPI_Init_thread before MPI_Comm_rank, operations.cc:1173-1181).

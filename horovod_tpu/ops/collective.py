@@ -52,6 +52,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
 from ..analysis import program as _program
+from .. import chaos as _chaos
 from ..core import compat as _compat
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
@@ -161,6 +162,14 @@ def _handle_lost_ranks(st, tp) -> None:
     pending = bool(_queue.pending_meta()) or bool(
         st.coordinator.check_stalled(threshold=0.0))
     detail = " while collectives were pending" if pending else ""
+    # hvd-chaos: a rank lost through the reconnect machinery (grace
+    # expiry, replay-ring overflow) carries a reason naming the fault —
+    # fold it into the diagnostic so operators see WHY, not just WHO.
+    reasons = getattr(tp, "lost_reasons", {})
+    why = "; ".join(f"rank {r}: {reasons[r]}" for r in ranks
+                    if r in reasons)
+    if why:
+        detail += f" ({why})"
     _telemetry.dead_peer_event(
         f"rank(s) {ranks} {wire.DEAD_PEER_MARKER}{detail}")
     _initiate_shutdown(
@@ -1288,6 +1297,11 @@ def _background_loop(stop_event: threading.Event) -> None:
     st = _state.global_state()
     while not stop_event.wait(st.tick_seconds or TICK_SECONDS):
         try:
+            # hvd-chaos coord.tick_delay: a starved/descheduled drain
+            # thread — the runtime must tolerate arbitrary tick jitter
+            # (stall warnings may fire; results must not change).
+            if _chaos.active():
+                _chaos.sleep_site("coord.tick_delay")
             _drain()
         except Exception:
             # Validation errors never propagate here (they are stored on
@@ -2083,6 +2097,13 @@ def _coordinator_tick(st):
     for set_ps in _state.process_sets_snapshot():
         if set_ps.coordinator is not None:
             negotiated += set_ps.coordinator.poll_responses(meta)
+    # hvd-chaos coord.reorder: permute ONLY the freshly negotiated
+    # responses of this tick (never across the marker/replay prefix —
+    # that ordering is load-bearing for replica alignment).  Responses
+    # within one tick carry no cross-response ordering contract, so a
+    # recovered run must stay bitwise-identical under the permutation.
+    if _chaos.active():
+        negotiated = _chaos.maybe_reorder("coord.reorder", negotiated)
     # Marker FIRST: replicas must flush before inserting anything this
     # tick's negotiations produce; replayed responses reference live
     # (post-flush) entries whenever a marker is present, so the order
@@ -2111,6 +2132,10 @@ def _drain() -> None:
                 # local pending ops (≙ operations.cc:1377-1403).
                 if tp.shutdown_requested.is_set() and not st.peer_shutdown:
                     _initiate_shutdown()
+                # hvd-chaos reconnect: a disconnected worker whose
+                # grace window expired without a session resume becomes
+                # a lost rank (with a diagnostic naming the fault).
+                tp.expire_grace()
                 # A worker's connection dropped without a shutdown frame:
                 # the process died (or exited without calling shutdown()).
                 # With collectives pending this is fatal — fail them with

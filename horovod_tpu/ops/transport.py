@@ -16,11 +16,39 @@ the same numbers from ``MPI_Comm_split_type(SHARED)``
 (operations.cc:1184-1196).
 
 Frame layout: ``<u32 length><u8 type><payload>`` (little-endian).
+
+Transient-fault hardening (hvd-chaos, docs/chaos.md)
+----------------------------------------------------
+A dropped TCP connection used to be terminal: the controller poisoned
+the fleet, the worker poisoned itself.  Both sides now run a
+**session-resume protocol**: every post-handshake frame is counted and
+retained in a bounded replay ring per direction; on a connection loss
+the worker reconnects with exponential backoff + jitter
+(utils/retry.py) and the two sides exchange their received-frame
+counts (FRAME_RECONNECT / FRAME_RESUME), re-sending exactly the lost
+suffix — the response stream every replica's cache alignment depends on
+is preserved bit-for-bit.  The handshake is epoch-stamped: a worker
+whose response-cache replica epoch no longer matches what the
+controller recorded at disconnect resumes **cache-less** instead of
+desyncing.  The controller holds a disconnected rank in a bounded
+grace window (``HVD_TPU_RECONNECT_GRACE``) — its in-flight negotiation
+entries stay pending (re-requested via the replay ring, never
+poisoned) — and only an expired window or an unplayable gap turns the
+rank into a dead peer with a diagnostic naming the fault.  Frame reads
+and writes additionally carry **mid-frame deadlines**
+(``HVD_TPU_FRAME_TIMEOUT``): a peer that stalls midway through a frame
+produces a diagnostic naming the peer and the frame type instead of a
+hang.  Chaos injection (``HVD_TPU_FAULTS``) hooks the send path —
+frame drop/delay/duplicate/truncate, connection reset, slow peer — so
+every one of these recoveries is deterministically testable
+(python -m horovod_tpu.chaos --matrix).
 """
 
 from __future__ import annotations
 
 import atexit
+import collections
+import itertools
 import json
 import os
 import queue
@@ -29,13 +57,15 @@ import struct
 import sys
 import threading
 import time
-from dataclasses import dataclass
-from typing import Dict, List, Optional
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 
 from . import wire
+from .. import chaos as _chaos
 from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
 from ..telemetry import flight as _flight
+from ..utils.retry import BackoffPolicy
 from .wire import DEAD_PEER_MARKER, Request, Response, ResponseType
 
 FRAME_HELLO = 0       # worker→controller: <i rank><H len><hostname>
@@ -80,6 +110,36 @@ FRAME_METRICS = 10        # hvd-telemetry pull (telemetry/__init__.py):
                           # + utf-8 JSON answers it.  Round-keyed like
                           # FRAME_SIGNATURE so a straggler snapshot from
                           # a timed-out pull never completes a later one
+FRAME_RECONNECT = 11      # worker→controller on a FRESH socket:
+                          # <i rank><I frames_received><i cache_epoch>
+                          # <B has_cache> — the session-resume request.
+                          # frames_received lets the controller replay
+                          # exactly the frames the worker never got;
+                          # the epoch stamp decides whether the
+                          # worker's cache replica may resume or must
+                          # be dropped (hvd-chaos reconnect protocol)
+FRAME_RESUME = 12         # controller→worker, answering RECONNECT:
+                          # <I frames_received><B verdict><H len><utf-8
+                          # reason>; verdict 0 = reject (reason names
+                          # why), 1 = resume with cache, 2 = resume
+                          # cache-less.  Followed by the raw replay of
+                          # every controller→worker frame the worker
+                          # missed, in original stream order
+
+_FRAME_NAMES = {
+    FRAME_HELLO: "HELLO", FRAME_REQUEST: "REQUEST",
+    FRAME_RESPONSES: "RESPONSES", FRAME_TOPO: "TOPO",
+    FRAME_SHUTDOWN: "SHUTDOWN", FRAME_WITHDRAW: "WITHDRAW",
+    FRAME_SIGNATURE: "SIGNATURE", FRAME_SIGRESULT: "SIGRESULT",
+    FRAME_REQUEST_BATCH: "REQUEST_BATCH",
+    FRAME_RESPONSE_BATCH: "RESPONSE_BATCH", FRAME_METRICS: "METRICS",
+    FRAME_RECONNECT: "RECONNECT", FRAME_RESUME: "RESUME",
+}
+
+
+def frame_name(ftype: Optional[int]) -> str:
+    return _FRAME_NAMES.get(ftype, f"type-{ftype}")
+
 
 _HDR = struct.Struct("<IB")
 
@@ -101,6 +161,93 @@ _M_BATCH_REQS = _telemetry.counter(
 _M_BATCH_WIDTH = _telemetry.histogram(
     "transport.batch_width", "count",
     "items (bits + requests) per coalesced control frame")
+# hvd-chaos hardening counters (docs/metrics.md "Fault tolerance").
+_M_DISCONNECTS = _telemetry.counter(
+    "transport.disconnects", "control-plane connections lost without a "
+    "shutdown handshake (reconnect grace entered)")
+_M_RECONNECTS = _telemetry.counter(
+    "transport.reconnects", "worker control-plane reconnects completed")
+_M_RECONNECTS_ACCEPTED = _telemetry.counter(
+    "transport.reconnects_accepted", "worker reconnects the controller "
+    "resumed")
+_M_RECONNECT_FAILURES = _telemetry.counter(
+    "transport.reconnect_failures", "reconnect attempts that failed "
+    "(connect refused / handshake error)")
+_M_REPLAYED = _telemetry.counter(
+    "transport.frames_replayed", "frames re-sent from a replay ring "
+    "after a reconnect")
+_M_FRAME_TIMEOUTS = _telemetry.counter(
+    "transport.frame_timeouts", "mid-frame read deadlines exceeded "
+    "(slow/stalled peer)")
+
+
+# -- env knobs (hvd-chaos hardening; read at call time so tests and the
+# -- chaos matrix can repoint them per scenario) ---------------------------
+
+def _reconnect_enabled() -> bool:
+    return os.environ.get("HVD_TPU_RECONNECT", "1") != "0"
+
+
+def _grace_seconds() -> float:
+    return float(os.environ.get("HVD_TPU_RECONNECT_GRACE", "10"))
+
+
+def _reconnect_deadline_seconds() -> float:
+    return float(os.environ.get("HVD_TPU_RECONNECT_DEADLINE", "10"))
+
+
+def _ring_limit() -> int:
+    return int(os.environ.get("HVD_TPU_RECONNECT_RING", "1024"))
+
+
+def _frame_timeout() -> Optional[float]:
+    v = float(os.environ.get("HVD_TPU_FRAME_TIMEOUT", "30"))
+    return v if v > 0 else None
+
+
+class FrameDeadlineError(OSError):
+    """A peer stalled midway through a frame (hvd-chaos frame-level
+    deadline).  Subclasses OSError so every broken-connection path —
+    reconnect on the worker, grace on the controller — handles it."""
+
+
+def _frame_deadline(peer: str, what: str, got: int,
+                    want: int) -> FrameDeadlineError:
+    msg = (f"control-plane frame deadline exceeded: peer {peer} stalled "
+           f"mid-frame ({what}, {got}/{want} bytes within "
+           f"{_frame_timeout()}s)")
+    _M_FRAME_TIMEOUTS.inc()
+    _flight.record("frame_timeout", peer, what, got, want)
+    print(f"WARNING: {msg}", file=sys.stderr)
+    return FrameDeadlineError(msg)
+
+
+class _FrameRing:
+    """Bounded replay ring for one send direction: every post-handshake
+    frame is appended with a cumulative index; ``since(n)`` returns the
+    frames the peer (which received ``n`` of them) is missing, or None
+    when the gap outgrew the ring — the unrecoverable case.  Callers
+    serialize access under their send lock."""
+
+    def __init__(self, limit: int) -> None:
+        self._limit = max(1, limit)
+        self._frames: collections.deque = collections.deque()
+        self._base = 0   # stream index of _frames[0]
+        self.count = 0   # frames ever appended
+
+    def append(self, ftype: int, payload: bytes) -> int:
+        self._frames.append((ftype, payload))
+        self.count += 1
+        if len(self._frames) > self._limit:
+            self._frames.popleft()
+            self._base += 1
+        return self.count
+
+    def since(self, received: int) -> Optional[List[Tuple[int, bytes]]]:
+        if received < self._base or received > self.count:
+            return None
+        return list(itertools.islice(
+            self._frames, received - self._base, len(self._frames)))
 
 
 def _send_frame(sock: socket.socket, ftype: int, payload: bytes = b"") -> None:
@@ -110,22 +257,146 @@ def _send_frame(sock: socket.socket, ftype: int, payload: bytes = b"") -> None:
     _M_FRAME_BYTES.observe(len(payload))
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def _wake_close(sock: socket.socket) -> None:
+    """Close a socket ANOTHER thread may be blocked reading.  A bare
+    ``close()`` does not wake a thread already parked in ``recv`` (the
+    fd is released but the syscall stays blocked — observed on this
+    kernel); ``shutdown`` delivers the EOF first, so the reader wakes
+    immediately instead of hanging until peer traffic arrives."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def _hard_close(sock: socket.socket) -> None:
+    """Close with RST (SO_LINGER 0) — the chaos 'connection reset'
+    wire effect; the peer's recv fails instead of seeing a clean EOF.
+    The shutdown also wakes any LOCAL thread blocked in recv on this
+    socket (the worker's own receive loop must notice a self-inflicted
+    reset and start reconnecting)."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    _wake_close(sock)
+
+
+def _apply_send_chaos(sock: socket.socket, ftype: int,
+                      payload: bytes) -> str:
+    """Consult the hvd-chaos schedule for one outgoing post-handshake
+    frame and perform the fault's wire effect.  Returns "send" (the
+    caller sends normally), "done" (the frame was dropped or already
+    put on the wire), or "dup" (the caller sends the frame TWICE and
+    accounts BOTH copies in its replay ring — the receiver counts both
+    deliveries, so the ring must too or a later session resume would
+    misalign).  Raises ConnectionResetError for the connection-killing
+    faults — the caller's broken-connection handling (reconnect /
+    grace) takes over, which is exactly the recovery under test."""
+    if not _chaos.active():
+        return "send"
+    if _chaos.fire("transport.drop") is not None:
+        return "done"  # silent loss; only a reconnect replay recovers it
+    if _chaos.fire("transport.reset") is not None:
+        _hard_close(sock)
+        raise ConnectionResetError(
+            f"hvd-chaos: transport.reset before {frame_name(ftype)}")
+    f = _chaos.fire("transport.trunc")
+    if f is not None:
+        blob = _HDR.pack(len(payload), ftype) + payload
+        cut = max(1, (len(blob) * 2) // 3)
+        try:
+            sock.sendall(blob[:cut])
+        except OSError:
+            pass
+        _hard_close(sock)
+        raise ConnectionResetError(
+            f"hvd-chaos: transport.trunc mid-{frame_name(ftype)} "
+            f"({cut}/{len(blob)} bytes)")
+    f = _chaos.fire("transport.stall")
+    if f is not None:
+        blob = _HDR.pack(len(payload), ftype) + payload
+        sock.sendall(blob[:_HDR.size])
+        time.sleep(f.delay)
+        sock.sendall(blob[_HDR.size:])
+        _M_TX.inc()
+        _M_TX_BYTES.inc(len(blob))
+        return "done"  # already on the wire, slowly
+    f = _chaos.fire("transport.delay")
+    if f is not None:
+        time.sleep(f.delay)
+    if _chaos.fire("transport.dup") is not None:
+        return "dup"
+    return "send"
+
+
+def _send_frame_or_fault(sock: socket.socket, ftype: int,
+                         payload: bytes = b"") -> int:
+    """The steady-state send: chaos consultation + the real send.
+    Returns the number of stream slots the frame consumed on the wire
+    (2 when chaos duplicated it) so the caller's replay ring stays
+    aligned with the receiver's frame count."""
+    act = _apply_send_chaos(sock, ftype, payload)
+    if act == "done":
+        return 1
+    _send_frame(sock, ftype, payload)
+    if act == "dup":
+        _send_frame(sock, ftype, payload)
+        return 2
+    return 1
+
+
+def _recv_exact(sock: socket.socket, n: int, idle_ok: bool = False,
+                peer: str = "", what: str = "") -> Optional[bytes]:
+    """Read exactly ``n`` bytes.  With a socket timeout armed
+    (post-handshake), a timeout BETWEEN frames is legal idleness
+    (``idle_ok``, header position only); a timeout once any byte of the
+    frame has arrived is a stalled peer — raised as
+    :class:`FrameDeadlineError` naming the peer and frame type."""
     buf = b""
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if idle_ok and not buf:
+                continue
+            raise _frame_deadline(peer or "?", what or "frame",
+                                  len(buf), n) from None
         if not chunk:
+            if buf:
+                # EOF midway through a frame: a truncated frame (the
+                # chaos transport.trunc wire effect, or a real reset
+                # mid-send).  Name the peer and the frame type — the
+                # reconnect/grace machinery recovers; this record is
+                # the forensic trail.
+                _flight.record("truncated_frame", peer or "?",
+                               what or "frame", len(buf), n)
+                print(f"WARNING: truncated control frame from "
+                      f"{peer or '?'} ({what or 'frame'}: {len(buf)}/"
+                      f"{n} bytes before EOF)", file=sys.stderr)
             return None
         buf += chunk
     return buf
 
 
-def _recv_frame(sock: socket.socket):
-    hdr = _recv_exact(sock, _HDR.size)
+def _recv_frame(sock: socket.socket, peer: str = "",
+                idle_ok: bool = True):
+    """Read one frame.  ``idle_ok=False`` makes even the wait for the
+    frame's FIRST byte subject to the socket timeout — the handshake
+    reads (RECONNECT/RESUME) use it so a silent peer bounds the wait
+    instead of idling forever."""
+    hdr = _recv_exact(sock, _HDR.size, idle_ok=idle_ok, peer=peer,
+                      what="header")
     if hdr is None:
         return None, None
     length, ftype = _HDR.unpack(hdr)
-    payload = _recv_exact(sock, length) if length else b""
+    payload = _recv_exact(sock, length, peer=peer,
+                          what=frame_name(ftype)) if length else b""
     if length and payload is None:
         return None, None
     _M_RX.inc()
@@ -189,6 +460,28 @@ def _assign_topology(hosts: Dict[int, str]) -> Dict[int, Topology]:
     return out
 
 
+@dataclass
+class _PeerSession:
+    """Controller-side per-worker session state surviving reconnects:
+    the live socket (None while disconnected), the outgoing replay
+    ring, the received-frame count, and the grace bookkeeping.  The
+    socket/grace fields are mutated under ControllerTransport._lock;
+    the ring under _send_lock; rx_count only by the one live receive
+    thread."""
+
+    rank: int
+    conn: Optional[socket.socket]
+    ring: _FrameRing
+    rx_count: int = 0
+    rx_thread: Optional[threading.Thread] = None
+    grace_deadline: Optional[float] = None
+    disc_epoch: int = -1
+    # True while a session resume is in flight on the accept thread:
+    # expire_grace must not declare the rank dead out from under a
+    # resume that is about to complete (the boundary-timing race).
+    resuming: bool = False
+
+
 class ControllerTransport:
     """Rank 0: accepts one connection per worker, feeds their Requests into
     the in-process coordinator, broadcasts Response lists to everyone."""
@@ -201,18 +494,26 @@ class ControllerTransport:
         self.cache = None
         self.num_processes = num_processes
         self.shutdown_requested = threading.Event()
-        # Ranks whose connection dropped without a SHUTDOWN frame — i.e.
-        # the process died (SURVEY §5 failure detection; the reference can
-        # only hang or MPI-abort here).
+        # Ranks whose connection dropped without a SHUTDOWN frame and
+        # whose reconnect grace (if any) expired — i.e. the process
+        # died (SURVEY §5 failure detection; the reference can only
+        # hang or MPI-abort here).
         self.lost_ranks: set = set()
+        # rank -> why it was declared lost (grace expiry / ring
+        # overflow); folded into the dead-peer diagnostic so the
+        # poison message names the fault, not just the rank.
+        self.lost_reasons: Dict[int, str] = {}
         self._closing = False
-        self._conns: Dict[int, socket.socket] = {}
         # Requests whose process set was not yet registered on arrival
         # (registration race): retried by flush_unrouted.
         self._unrouted: List = []
         self._lock = _lockorder.make_lock("ControllerTransport._lock")
         self._send_lock = _lockorder.make_lock(
             "ControllerTransport._send_lock")
+        # Per-worker sessions (socket + replay ring + grace state);
+        # the mapping itself is fixed after init — only session fields
+        # mutate (see _PeerSession's locking note).
+        self._sess: Dict[int, _PeerSession] = {}
         # verify_program rendezvous: round → rank → signature payload,
         # collected by the receive threads, consumed by rank 0's
         # verify_program (analysis/program.py).  Keyed by round so a
@@ -231,6 +532,7 @@ class ControllerTransport:
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("0.0.0.0", port))
         self._srv.listen(num_processes)
+        self.port = self._srv.getsockname()[1]
         self._threads: List[threading.Thread] = []
 
         hosts = {0: hostname or socket.gethostname()}
@@ -270,10 +572,22 @@ class ControllerTransport:
                 t.cross_rank, t.cross_size,
                 1 if _cache_mod.cache_enabled() else 0))
         with self._lock:
-            self._conns = socks
-        for rank, conn in socks.items():
-            th = threading.Thread(target=self._serve, args=(rank, conn),
-                                  name=f"hvd-controller-rx-{rank}",
+            for rank, conn in socks.items():
+                # Frame deadlines arm AFTER the handshake: idleness
+                # between frames stays legal, a stall mid-frame names
+                # the peer (FrameDeadlineError).
+                conn.settimeout(_frame_timeout())
+                self._sess[rank] = _PeerSession(
+                    rank=rank, conn=conn, ring=_FrameRing(_ring_limit()))
+        for rank in socks:
+            self._start_rx(rank, socks[rank])
+        # Session-resume listener: the server socket stays open so a
+        # worker whose connection dropped can reconnect
+        # (FRAME_RECONNECT) for the remainder of the job.
+        if _reconnect_enabled() and num_processes > 1:
+            self._srv.settimeout(None)
+            th = threading.Thread(target=self._accept_loop,
+                                  name="hvd-controller-accept",
                                   daemon=True)
             th.start()
             self._threads.append(th)
@@ -283,12 +597,252 @@ class ControllerTransport:
         # exit barrier, which a cleanly-exiting controller does reach).
         atexit.register(self._atexit_handshake)
 
+    def _start_rx(self, rank: int, conn: socket.socket) -> None:
+        th = threading.Thread(target=self._serve, args=(rank, conn),
+                              name=f"hvd-controller-rx-{rank}",
+                              daemon=True)
+        with self._lock:
+            self._sess[rank].rx_thread = th
+        th.start()
+        self._threads.append(th)
+
     def _atexit_handshake(self) -> None:
         if self._closing:
             return
         try:
             self.broadcast_responses(
                 [Response(ResponseType.SHUTDOWN)])
+        except OSError:
+            pass
+
+    # -- session-resume listener (hvd-chaos reconnect) ---------------------
+    def _accept_loop(self) -> None:
+        try:
+            self._accept_loop_inner()
+        except Exception:
+            import traceback
+
+            _telemetry.exception_event(
+                "controller-accept", traceback.format_exc())
+            raise
+
+    def _accept_loop_inner(self) -> None:
+        while not self._closing:
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return  # close() shut the server socket down
+            try:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                conn.settimeout(10.0)
+                ftype, payload = _recv_frame(conn, peer="reconnecting",
+                                             idle_ok=False)
+            except OSError:
+                continue
+            if (ftype != FRAME_RECONNECT or self._closing
+                    or len(payload) < 13):
+                # Wrong/garbled first frame (version skew, a stray
+                # client probing the port): drop the connection, keep
+                # the listener — this loop must survive the whole job
+                # or every later legitimate reconnect dies with it.
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            try:
+                self._handle_reconnect(conn, payload)
+            except Exception:  # noqa: BLE001 — one bad resume must
+                # not kill the listener for the rest of the job
+                import traceback
+
+                _telemetry.exception_event(
+                    "controller-resume", traceback.format_exc())
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _mark_disconnected(self, sess: _PeerSession, why: str) -> None:
+        """A worker's connection broke (receive EOF, send failure, or a
+        reconnect superseding a half-dead socket): close it and either
+        open the reconnect grace window or — reconnect disabled /
+        already shutting down — declare the rank lost immediately (the
+        pre-chaos behavior)."""
+        with self._lock:
+            conn, sess.conn = sess.conn, None
+            if conn is not None:
+                # shutdown-then-close: the rank's receive thread may be
+                # blocked in recv on this socket and must wake NOW (a
+                # bare close leaves it parked on this kernel).
+                _wake_close(conn)
+            if self.shutdown_requested.is_set() or self._closing:
+                return
+            if sess.rank in self.lost_ranks:
+                return
+            if not _reconnect_enabled():
+                self.lost_ranks.add(sess.rank)
+                return
+            if sess.grace_deadline is None:
+                grace = _grace_seconds()
+                sess.grace_deadline = time.monotonic() + grace
+                sess.disc_epoch = (self.cache.epoch
+                                   if self.cache is not None else -1)
+                _M_DISCONNECTS.inc()
+                _telemetry.transport_fault_event(
+                    "peer-disconnect", f"rank {sess.rank}: {why}")
+                print(f"[hvd-reconnect] controller: rank {sess.rank} "
+                      f"control-plane connection lost ({why}); holding "
+                      f"its session for {grace:.1f}s grace",
+                      file=sys.stderr)
+
+    def expire_grace(self) -> None:
+        """Drain-tick sweep: a disconnected rank whose grace window
+        expired without a reconnect becomes a dead peer — the bounded
+        end of the no-hang contract, with a diagnostic naming the
+        fault (``lost_reasons``)."""
+        if not self._sess:
+            return
+        now = time.monotonic()
+        with self._lock:
+            for sess in self._sess.values():
+                if (sess.grace_deadline is not None
+                        and not sess.resuming
+                        and now > sess.grace_deadline
+                        and sess.rank not in self.lost_ranks):
+                    reason = (f"control-plane connection lost; no "
+                              f"reconnect within "
+                              f"{_grace_seconds():.1f}s grace")
+                    self.lost_ranks.add(sess.rank)
+                    self.lost_reasons[sess.rank] = reason
+                    sess.grace_deadline = None
+                    _flight.record("grace_expired", sess.rank)
+                    print(f"ERROR: rank {sess.rank}: {reason}",
+                          file=sys.stderr)
+
+    def _handle_reconnect(self, conn: socket.socket,
+                          payload: bytes) -> None:
+        """Resume one worker's session on a fresh socket: compare
+        received-frame counts, replay the lost controller→worker
+        suffix, and verdict the worker's cache replica (resume when its
+        epoch matches the disconnect-time epoch, drop it otherwise).
+        Serialized against broadcasts by ``_send_lock`` so no new frame
+        can interleave ahead of the replayed suffix."""
+        rank, their_rx, epoch, has_cache = struct.unpack_from(
+            "<iIiB", payload)
+        with self._lock:
+            sess = self._sess.get(rank)
+            lost = rank in self.lost_ranks
+        if sess is None or lost:
+            why = (self.lost_reasons.get(rank, "declared dead")
+                   if lost else "unknown rank")
+            self._reject_reconnect(conn, rank, why)
+            return
+        # Shield the session from expire_grace while the resume is in
+        # flight: a reconnect landing near the grace deadline must not
+        # be completed here while the drain tick concurrently declares
+        # the rank dead (resuming is cleared — and the grace window
+        # re-armed on failure — in the finally below).
+        with self._lock:
+            sess.resuming = True
+        try:
+            self._resume_session(sess, conn, their_rx, epoch, has_cache)
+        finally:
+            with self._lock:
+                sess.resuming = False
+                if (sess.conn is None
+                        and sess.rank not in self.lost_ranks
+                        and not (self.shutdown_requested.is_set()
+                                 or self._closing)):
+                    # The resume failed mid-handshake: give the worker
+                    # a fresh full grace window to try again — and keep
+                    # the bounded no-hang contract armed.
+                    sess.grace_deadline = (time.monotonic()
+                                           + _grace_seconds())
+
+    def _resume_session(self, sess: _PeerSession, conn: socket.socket,
+                        their_rx: int, epoch: int,
+                        has_cache: int) -> None:
+        rank = sess.rank
+        # Supersede a half-dead socket the controller had not noticed
+        # dropping yet, and wait for its receive thread to finish so
+        # the rx_count we report is final (no frame can be double-
+        # counted between our report and the worker's replay).
+        self._mark_disconnected(sess, "superseded by reconnect")
+        rx_th = sess.rx_thread
+        if rx_th is not None and rx_th is not threading.current_thread():
+            rx_th.join(timeout=5.0)
+        with self._send_lock:
+            suffix = sess.ring.since(their_rx)
+            if suffix is None:
+                reason = (f"cannot resume rank {rank}: it received "
+                          f"{their_rx} of {sess.ring.count} frames but "
+                          f"the replay ring no longer holds that "
+                          f"suffix (gap beyond HVD_TPU_RECONNECT_RING)")
+                with self._lock:
+                    self.lost_ranks.add(rank)
+                    self.lost_reasons[rank] = \
+                        "reconnect replay ring overflow"
+                self._reject_reconnect(conn, rank, reason)
+                return
+            drop_cache = bool(has_cache) and (
+                self.cache is None or epoch != sess.disc_epoch)
+            verdict = 2 if drop_cache else 1
+            reason = (f"cache epoch {epoch} != disconnect-time epoch "
+                      f"{sess.disc_epoch}; resume cache-less"
+                      if drop_cache else "")
+            rb = reason.encode("utf-8")
+            try:
+                _send_frame(conn, FRAME_RESUME,
+                            struct.pack("<IBH", sess.rx_count, verdict,
+                                        len(rb)) + rb)
+                for ftype, fpayload in suffix:
+                    _send_frame(conn, ftype, fpayload)
+                    _M_REPLAYED.inc()
+            except OSError:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return  # still in grace; the worker may try again
+            conn.settimeout(_frame_timeout())
+            with self._lock:
+                sess.conn = conn
+                sess.grace_deadline = None
+        _M_RECONNECTS_ACCEPTED.inc()
+        _flight.record("reconnect_accepted", rank, their_rx,
+                       len(suffix), verdict)
+        print(f"[hvd-reconnect] controller: rank {rank} resumed "
+              f"(replayed {len(suffix)} frames"
+              f"{', cache dropped' if drop_cache else ''})",
+              file=sys.stderr)
+        if drop_cache and self.cache is not None:
+            # The worker's replica is gone: flush the shared cache so
+            # no compact replay frame it cannot reconstitute is ever
+            # broadcast; mid-flight cached submissions downgrade into
+            # real negotiations (never lost).
+            for req in self.cache.flush(
+                    f"rank {rank} reconnected cache-less",
+                    broadcast=True):
+                if not self._try_submit(req):
+                    with self._lock:
+                        self._unrouted.append(
+                            (time.monotonic() + 5.0, req))
+        self._start_rx(rank, conn)
+
+    def _reject_reconnect(self, conn: socket.socket, rank: int,
+                          reason: str) -> None:
+        print(f"[hvd-reconnect] controller: rejecting reconnect from "
+              f"rank {rank}: {reason}", file=sys.stderr)
+        _flight.record("reconnect_rejected", rank, reason)
+        rb = reason.encode("utf-8")
+        try:
+            _send_frame(conn, FRAME_RESUME,
+                        struct.pack("<IBH", 0, 0, len(rb)) + rb)
+        except OSError:
+            pass
+        try:
+            conn.close()
         except OSError:
             pass
 
@@ -306,19 +860,24 @@ class ControllerTransport:
             raise
 
     def _serve_inner(self, rank: int, conn: socket.socket) -> None:
+        sess = self._sess[rank]
         while True:
             try:
-                ftype, payload = _recv_frame(conn)
+                ftype, payload = _recv_frame(conn, peer=f"rank {rank}")
             except OSError:
                 ftype = None  # worker died mid-frame / reset the conn
             if ftype is None:
-                # EOF without a SHUTDOWN frame = the worker terminated
-                # unexpectedly; the drain loop will poison pending ops.
+                with self._lock:
+                    superseded = sess.conn is not conn
+                if superseded:
+                    return  # a reconnect already installed a new socket
+                # EOF without a SHUTDOWN frame = the connection (or the
+                # worker) went away; grace/lost handling decides which.
                 if not (self.shutdown_requested.is_set() or self._closing):
                     _flight.record("peer_eof", rank)
-                    with self._lock:
-                        self.lost_ranks.add(rank)
+                    self._mark_disconnected(sess, "eof")
                 return
+            sess.rx_count += 1
             if ftype == FRAME_REQUEST:
                 req, _ = Request.unpack(payload)
                 if not self._try_submit(req):
@@ -484,14 +1043,7 @@ class ControllerTransport:
             rnd = self._sig_round
         payload = struct.pack("<IB", rnd, 0 if error else 1) + (
             error or "").encode("utf-8")
-        with self._send_lock:
-            with self._lock:
-                conns = list(self._conns.values())
-            for conn in conns:
-                try:
-                    _send_frame(conn, FRAME_SIGRESULT, payload)
-                except OSError:
-                    pass  # worker already gone; its own timeout reports
+        self._broadcast_frame(FRAME_SIGRESULT, payload)
 
     # -- hvd-telemetry pull (telemetry/__init__.py cluster_metrics) --------
     def collect_metrics(self, own: dict,
@@ -508,15 +1060,7 @@ class ControllerTransport:
             rnd = self._met_round
             this_round = self._met_payloads.setdefault(rnd, {})
             this_round[0] = own
-        payload = struct.pack("<I", rnd)
-        with self._send_lock:
-            with self._lock:
-                conns = list(self._conns.values())
-            for conn in conns:
-                try:
-                    _send_frame(conn, FRAME_METRICS, payload)
-                except OSError:
-                    pass  # worker already gone; absent from the result
+        self._broadcast_frame(FRAME_METRICS, struct.pack("<I", rnd))
         with self._met_cond:
             try:
                 while len(this_round) < self.num_processes:
@@ -555,21 +1099,36 @@ class ControllerTransport:
             pass  # duplicate-name caller bug; surfaces via timeout
         return False
 
+    def _broadcast_frame(self, ftype: int, payload: bytes) -> None:
+        """Send one frame to every worker session.  Every frame is
+        appended to the per-rank replay ring FIRST — a rank in its
+        reconnect grace window receives the frames on resume, in
+        original order, so the response stream (and with it every
+        cache replica) survives the disconnect bit-for-bit.
+        ``_send_lock`` serializes whole frames: the drain thread and a
+        shutdown()-calling user thread must not interleave bytes on
+        one socket."""
+        with self._send_lock:
+            with self._lock:
+                sessions = list(self._sess.values())
+            for sess in sessions:
+                sess.ring.append(ftype, payload)
+                conn = sess.conn
+                if conn is None:
+                    continue
+                try:
+                    if _send_frame_or_fault(conn, ftype, payload) == 2:
+                        sess.ring.append(ftype, payload)  # chaos dup
+                except OSError as e:
+                    # Send-side break detection (connection reset
+                    # mid-frame): same grace path as a receive EOF.
+                    self._mark_disconnected(sess, f"send failed: {e}")
+
     def broadcast_responses(self, responses: List[Response]) -> None:
         _flight.record("bcast_responses", len(responses),
                        ",".join(r.response_type.name for r in responses))
-        payload = wire.pack_response_list(responses)
-        # _send_lock serializes whole frames: the drain thread and a
-        # shutdown()-calling user thread must not interleave bytes on one
-        # socket.
-        with self._send_lock:
-            with self._lock:
-                conns = list(self._conns.values())
-            for conn in conns:
-                try:
-                    _send_frame(conn, FRAME_RESPONSES, payload)
-                except OSError:
-                    pass  # worker already gone; its own stall path reports
+        self._broadcast_frame(FRAME_RESPONSES,
+                              wire.pack_response_list(responses))
 
     def broadcast_replay(self, groups: List[List[int]],
                          epoch: int) -> None:
@@ -582,14 +1141,7 @@ class ControllerTransport:
         for g in groups:
             payload += struct.pack("<H", len(g))
             payload += struct.pack(f"<{len(g)}I", *g)
-        with self._send_lock:
-            with self._lock:
-                conns = list(self._conns.values())
-            for conn in conns:
-                try:
-                    _send_frame(conn, FRAME_RESPONSE_BATCH, payload)
-                except OSError:
-                    pass  # worker already gone; its own stall path reports
+        self._broadcast_frame(FRAME_RESPONSE_BATCH, payload)
 
     def poll_responses(self):
         return None  # responses come from the coordinator on rank 0
@@ -598,13 +1150,12 @@ class ControllerTransport:
         self._closing = True
         atexit.unregister(self._atexit_handshake)
         with self._lock:
-            conns = list(self._conns.values())
-            self._conns.clear()
+            conns = [s.conn for s in self._sess.values()
+                     if s.conn is not None]
+            for s in self._sess.values():
+                s.conn = None
         for conn in conns:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            _wake_close(conn)
         self._srv.close()
 
 
@@ -616,6 +1167,8 @@ class WorkerTransport:
                  hostname: Optional[str] = None,
                  connect_timeout: float = 60.0):
         self.rank = rank
+        self._host = host
+        self._port = port
         # Shared response-cache replica (ops/cache.py), attached by
         # core.state.init after construction; None = caching disabled.
         self.cache = None
@@ -632,24 +1185,43 @@ class WorkerTransport:
         # verdict left queued by a timed-out earlier round.
         self._sig_results: "queue.Queue" = queue.Queue()
         self._sig_round = 0
+        # Session-resume state (hvd-chaos): outgoing replay ring +
+        # received-frame count, mirroring the controller's per-rank
+        # session.  The ring and _broken are guarded by _send_lock;
+        # _rx_count is only touched by the receive thread.
+        self._ring = _FrameRing(_ring_limit())
+        self._rx_count = 0
+        self._broken = False
+        self._send_lock = _lockorder.make_lock("WorkerTransport._send_lock")
+        # Initial connect: capped exponential backoff with full jitter
+        # (utils/retry.py — the SAME policy the reconnect path uses),
+        # each attempt logged with the remaining deadline so a slow
+        # controller start is observable, not silent.
         deadline = time.monotonic() + connect_timeout
-        last_err: Optional[Exception] = None
+        policy = BackoffPolicy(base=0.05, cap=2.0)
+        attempt = 0
         while True:
             try:
                 self._sock = socket.create_connection((host, port),
                                                       timeout=5.0)
                 break
             except OSError as e:
-                last_err = e
-                if time.monotonic() > deadline:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
                     raise TimeoutError(
                         f"rank {rank} could not reach the controller at "
                         f"{host}:{port} within {connect_timeout}s: "
-                        f"{last_err}") from last_err
-                time.sleep(0.1)
+                        f"{e}") from e
+                delay = min(policy.delay(attempt), max(remaining, 0.0))
+                attempt += 1
+                print(f"[hvd-connect] rank {rank}: controller "
+                      f"{host}:{port} not reachable (attempt {attempt}: "
+                      f"{e}); retrying in {delay:.2f}s "
+                      f"({remaining:.1f}s before deadline)",
+                      file=sys.stderr)
+                time.sleep(delay)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._send_lock = _lockorder.make_lock("WorkerTransport._send_lock")
         hb = (hostname or socket.gethostname()).encode("utf-8")
         from . import compression as _compression
 
@@ -669,6 +1241,10 @@ class WorkerTransport:
         self.controller_cache = bool(struct.unpack_from(
             "<i", payload, 16)[0]) if len(payload) >= 20 else True
         self.topology = Topology(lr, ls, cr, cs)
+        # Frame deadlines arm after the handshake (see the controller's
+        # mirror): idle-between-frames is legal, a mid-frame stall
+        # names the controller and the frame type.
+        self._sock.settimeout(_frame_timeout())
         self._rx = threading.Thread(target=self._recv_loop,
                                     name=f"hvd-worker-rx-{rank}", daemon=True)
         self._rx.start()
@@ -691,6 +1267,29 @@ class WorkerTransport:
         except OSError:
             pass  # controller already gone
 
+    # -- outgoing frames (ring + chaos + broken-socket buffering) ----------
+    def _send(self, ftype: int, payload: bytes = b"") -> None:
+        """The one post-handshake send path: append to the replay ring,
+        then send unless the connection is currently broken — a broken
+        connection buffers in the ring and the reconnect handshake
+        replays exactly the suffix the controller never received, so a
+        send during a disconnect is delayed, never lost (until the
+        ring's bound, which fails the reconnect loudly)."""
+        with self._send_lock:
+            self._ring.append(ftype, payload)
+            if self._broken:
+                return
+            sock = self._sock
+            try:
+                if _send_frame_or_fault(sock, ftype, payload) == 2:
+                    self._ring.append(ftype, payload)  # chaos dup
+            except OSError:
+                # Mark broken and shutdown-close: the receive thread
+                # (possibly parked in recv) wakes on the EOF and runs
+                # the reconnect path.
+                self._broken = True
+                _wake_close(sock)
+
     def _recv_loop(self) -> None:
         # Mirror of the controller's receive-thread guard: dump the
         # flight ring before an unhandled exception kills the thread.
@@ -703,31 +1302,48 @@ class WorkerTransport:
                 "worker-rx", traceback.format_exc())
             raise
 
+    def _poison(self, detail: str) -> None:
+        """Controller connection unrecoverable: surface a synthetic
+        SHUTDOWN response so pending ops fail with a diagnosis instead
+        of hanging (mirror of the controller's dead-worker path)."""
+        from ..core.cluster import disarm_distributed_shutdown
+
+        # The controller can never reach jax.distributed's exit
+        # barrier; don't block (then abort) on it.
+        disarm_distributed_shutdown()
+        _telemetry.dead_peer_event(
+            f"rank {self.rank}: controller unreachable ({detail})")
+        self._responses.put([Response(
+            ResponseType.SHUTDOWN,
+            error_message="Horovod has been shut down: the rank-0 "
+            f"controller {DEAD_PEER_MARKER} while collectives were "
+            f"pending ({detail}).")])
+
     def _recv_loop_inner(self) -> None:
         while True:
+            sock = self._sock
             try:
-                ftype, payload = _recv_frame(self._sock)
+                ftype, payload = _recv_frame(sock, peer="controller")
             except OSError:
                 ftype = None
             if ftype is None:
-                # Controller connection lost: if this wasn't a clean
-                # shutdown, surface it as a synthetic SHUTDOWN response so
-                # pending ops fail with a diagnosis instead of hanging
-                # (mirror of the controller's dead-worker detection).
-                if not (self.shutdown_received.is_set() or self._closing):
-                    from ..core.cluster import disarm_distributed_shutdown
-
-                    # EOF without a SHUTDOWN response (not even the
-                    # controller's exit handshake): the controller crashed
-                    # and can never reach jax.distributed's exit barrier;
-                    # don't block (then abort) on it.
-                    disarm_distributed_shutdown()
-                    self._responses.put([Response(
-                        ResponseType.SHUTDOWN,
-                        error_message="Horovod has been shut down: the "
-                        f"rank-0 controller {DEAD_PEER_MARKER} while "
-                        "collectives were pending.")])
+                # Connection lost: clean shutdown → exit quietly;
+                # otherwise try the session-resume protocol, and only
+                # an exhausted/failed reconnect poisons pending ops.
+                if self.shutdown_received.is_set() or self._closing:
+                    return
+                _flight.record("ctrl_eof", self.rank)
+                if _reconnect_enabled():
+                    why = self._reconnect()
+                    if why is None:
+                        continue  # resumed; keep receiving
+                else:
+                    why = "reconnect disabled (HVD_TPU_RECONNECT=0)"
+                if self._closing or self.shutdown_received.is_set():
+                    return
+                self._poison(why)
                 return
+            self._rx_count += 1
             if ftype == FRAME_RESPONSE_BATCH:
                 epoch, ngroups = struct.unpack_from("<IH", payload)
                 off = 6
@@ -775,13 +1391,8 @@ class WorkerTransport:
                     body = json.dumps(_telemetry.metrics()).encode("utf-8")
                 except Exception:  # noqa: BLE001 — must answer regardless
                     body = b"{}"
-                with self._send_lock:
-                    try:
-                        _send_frame(self._sock, FRAME_METRICS,
-                                    struct.pack("<iI", self.rank, rnd)
-                                    + body)
-                    except OSError:
-                        pass  # controller gone; its pull times out
+                self._send(FRAME_METRICS,
+                           struct.pack("<iI", self.rank, rnd) + body)
                 continue
             if ftype == FRAME_RESPONSES:
                 resps = wire.unpack_response_list(payload)
@@ -792,6 +1403,130 @@ class WorkerTransport:
                        for r in resps):
                     self.shutdown_received.set()
                 self._responses.put(resps)
+
+    # -- session resume (hvd-chaos reconnect protocol) ---------------------
+    def _drop_cache_replica(self) -> None:
+        """The controller's cache-less resume verdict: drop the local
+        replica — this rank sends full requests from here on (a
+        supported steady state: the controller marks its cycles
+        non-compact), instead of executing desynced replays."""
+        self.cache = None
+        try:
+            from ..core import state as _state
+
+            st = _state.global_state()
+            if st.transport is self:
+                st.response_cache = None
+        except Exception:  # noqa: BLE001 — best-effort state sync
+            pass
+
+    def _reconnect(self) -> Optional[str]:
+        """Re-establish the controller session with exponential backoff
+        + jitter (shared BackoffPolicy) within
+        ``HVD_TPU_RECONNECT_DEADLINE``.  Returns None on success (the
+        receive loop continues on the fresh socket) or the failure
+        diagnostic — the bounded, named end of the no-hang contract."""
+        deadline = time.monotonic() + _reconnect_deadline_seconds()
+        policy = BackoffPolicy(base=0.05, cap=2.0)
+        attempt = 0
+        last: Optional[str] = None
+        while not (self._closing or self.shutdown_received.is_set()):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            attempt += 1
+            print(f"[hvd-reconnect] rank {self.rank}: attempt {attempt} "
+                  f"to {self._host}:{self._port} ({remaining:.1f}s "
+                  f"before deadline"
+                  f"{'; last error: ' + last if last else ''})",
+                  file=sys.stderr)
+            _flight.record("reconnect_attempt", self.rank, attempt)
+            try:
+                terminal = self._try_resume(min(5.0, max(0.2, remaining)))
+                if terminal is None:
+                    return None
+                # A terminal verdict (controller rejection, outgoing
+                # ring overflow): retrying cannot succeed.
+                return terminal
+            except OSError as e:
+                last = f"{type(e).__name__}: {e}"
+                _M_RECONNECT_FAILURES.inc()
+            delay = min(policy.delay(attempt - 1),
+                        max(0.0, deadline - time.monotonic()))
+            time.sleep(delay)
+        return (f"no reconnect within "
+                f"{_reconnect_deadline_seconds():.1f}s "
+                f"({attempt} attempts; last error: {last})")
+
+    def _try_resume(self, timeout: float) -> Optional[str]:
+        """One reconnect attempt: fresh socket, FRAME_RECONNECT with
+        our received-frame count + cache epoch, FRAME_RESUME verdict,
+        then replay our unacknowledged outgoing suffix.  Returns None
+        on resume, a TERMINAL failure reason (controller rejection,
+        outgoing-ring overflow — conditions no retry can cure) as a
+        string; raises OSError on a retryable failure."""
+        sock = socket.create_connection((self._host, self._port),
+                                        timeout=timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(10.0)
+            cache = self.cache
+            epoch = cache.epoch if cache is not None else -1
+            _send_frame(sock, FRAME_RECONNECT, struct.pack(
+                "<iIiB", self.rank, self._rx_count, epoch,
+                1 if cache is not None else 0))
+            ftype, payload = _recv_frame(sock, peer="controller",
+                                         idle_ok=False)
+            if ftype != FRAME_RESUME:
+                raise OSError(
+                    f"expected RESUME, got {frame_name(ftype)}")
+            ctrl_rx, verdict, rlen = struct.unpack_from("<IBH", payload)
+            reason = payload[7:7 + rlen].decode("utf-8")
+            if verdict == 0:
+                print(f"[hvd-reconnect] rank {self.rank}: controller "
+                      f"rejected resume: {reason}", file=sys.stderr)
+                sock.close()
+                return f"controller rejected the session resume: {reason}"
+            if verdict == 2:
+                print(f"[hvd-reconnect] rank {self.rank}: resuming "
+                      f"cache-less: {reason}", file=sys.stderr)
+                self._drop_cache_replica()
+            with self._send_lock:
+                suffix = self._ring.since(ctrl_rx)
+                if suffix is None:
+                    # Permanent: ctrl_rx is fixed and the ring only
+                    # sheds more frames — retrying burns the deadline
+                    # for nothing.  Fail terminally, like the
+                    # controller-side mirror of this condition.
+                    sock.close()
+                    return (f"outgoing replay ring overflow "
+                            f"(controller received {ctrl_rx} of "
+                            f"{self._ring.count} frames; "
+                            f"HVD_TPU_RECONNECT_RING too small)")
+                for ftype2, payload2 in suffix:
+                    _send_frame(sock, ftype2, payload2)
+                    _M_REPLAYED.inc()
+                sock.settimeout(_frame_timeout())
+                old, self._sock = self._sock, sock
+                self._broken = False
+            _wake_close(old)
+            _M_RECONNECTS.inc()
+            _flight.record("reconnected", self.rank, ctrl_rx,
+                           len(suffix), verdict)
+            _telemetry.transport_fault_event(
+                "reconnect", f"rank {self.rank} resumed: replayed "
+                f"{len(suffix)} frames, verdict {verdict}")
+            print(f"[hvd-reconnect] rank {self.rank}: session resumed "
+                  f"(replayed {len(suffix)} frames"
+                  f"{', cache dropped' if verdict == 2 else ''})",
+                  file=sys.stderr)
+            return None
+        except OSError:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
 
     def submit(self, req: Request) -> bool:
         """Buffer one request for the next coalesced control frame;
@@ -837,27 +1572,25 @@ class WorkerTransport:
         _flight.record("frame_tx_batch", len(items) - len(reqs),
                        len(reqs))
         epochs = sorted(by_epoch) or [0]
-        with self._send_lock:
-            for i, epoch in enumerate(epochs):
-                idxs = by_epoch.get(epoch, [])
-                bitvec = b""
-                if idxs:
-                    arr = bytearray(max(idxs) // 8 + 1)
-                    for b in idxs:
-                        arr[b // 8] |= 1 << (b % 8)
-                    bitvec = bytes(arr)
-                # The full requests ride the last epoch's frame.
-                tail = b"".join(reqs) if i == len(epochs) - 1 else b""
-                nreq = len(reqs) if i == len(epochs) - 1 else 0
-                _send_frame(
-                    self._sock, FRAME_REQUEST_BATCH,
-                    struct.pack("<iII", self.rank, epoch, len(bitvec))
-                    + bitvec + struct.pack("<H", nreq) + tail)
+        for i, epoch in enumerate(epochs):
+            idxs = by_epoch.get(epoch, [])
+            bitvec = b""
+            if idxs:
+                arr = bytearray(max(idxs) // 8 + 1)
+                for b in idxs:
+                    arr[b // 8] |= 1 << (b % 8)
+                bitvec = bytes(arr)
+            # The full requests ride the last epoch's frame.
+            tail = b"".join(reqs) if i == len(epochs) - 1 else b""
+            nreq = len(reqs) if i == len(epochs) - 1 else 0
+            self._send(
+                FRAME_REQUEST_BATCH,
+                struct.pack("<iII", self.rank, epoch, len(bitvec))
+                + bitvec + struct.pack("<H", nreq) + tail)
 
     def request_shutdown(self) -> None:
         self.flush_requests()  # preserve request-before-shutdown order
-        with self._send_lock:
-            _send_frame(self._sock, FRAME_SHUTDOWN)
+        self._send(FRAME_SHUTDOWN)
 
     def exchange_signature(self, payload: bytes,
                            timeout: float) -> Optional[str]:
@@ -869,9 +1602,8 @@ class WorkerTransport:
         self._sig_round += 1
         rnd = self._sig_round
         self.flush_requests()  # keep buffered requests ahead in-stream
-        with self._send_lock:
-            _send_frame(self._sock, FRAME_SIGNATURE,
-                        struct.pack("<iI", self.rank, rnd) + payload)
+        self._send(FRAME_SIGNATURE,
+                   struct.pack("<iI", self.rank, rnd) + payload)
         deadline = time.monotonic() + timeout
         while True:
             remaining = deadline - time.monotonic()
@@ -895,11 +1627,10 @@ class WorkerTransport:
         fails the op group-wide."""
         nb = name.encode("utf-8")
         self.flush_requests()  # keep buffered requests ahead in-stream
-        with self._send_lock:
-            _send_frame(self._sock, FRAME_WITHDRAW,
-                        struct.pack("<i", self.rank)
-                        + struct.pack("<H", len(nb)) + nb
-                        + struct.pack("<H", process_set_id))
+        self._send(FRAME_WITHDRAW,
+                   struct.pack("<i", self.rank)
+                   + struct.pack("<H", len(nb)) + nb
+                   + struct.pack("<H", process_set_id))
 
     def poll_responses(self) -> Optional[List[Response]]:
         """Next broadcast response list, or None if nothing arrived."""
@@ -911,7 +1642,4 @@ class WorkerTransport:
     def close(self) -> None:
         self._closing = True
         atexit.unregister(self._atexit_handshake)
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        _wake_close(self._sock)

@@ -52,6 +52,7 @@ from typing import Any, Iterable, Iterator, Optional
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import chaos as _chaos
 from .. import telemetry as _telemetry
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
@@ -128,6 +129,13 @@ class PrefetchIterator:
                 except StopIteration:
                     self._put(_END)
                     return
+                # hvd-chaos input.stall: a loader/filesystem hiccup on
+                # the stager thread.  The contract under injection: the
+                # consumer sees added latency (host.stall_seconds), the
+                # batch ORDER and VALUES never change — training stays
+                # bitwise-identical to the fault-free run.
+                if _chaos.active():
+                    _chaos.sleep_site("input.stall")
                 staged = device_put_batch(host_batch, self._mesh,
                                           self._sharding)
                 _M_STAGED.inc()

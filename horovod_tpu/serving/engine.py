@@ -50,6 +50,7 @@ from jax.sharding import PartitionSpec as P
 from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
 from ..core.topology import MODEL_AXIS
+from ..telemetry import flight as _flight
 from ..models import transformer as _transformer
 from ..ops import megakernel as _megakernel
 from .kv_cache import PagedKVCache
@@ -402,6 +403,16 @@ class InferenceEngine:
                                    for slot, req in admitted]})
         for slot, req in admitted:
             self._prefill_and_sample(slot, req)
+        # Clean abort of disconnected clients' slots (hvd-chaos): the
+        # eviction happens HERE, at the iteration boundary on the
+        # serve-loop thread — the only thread that may free KV slots —
+        # and rides the step broadcast's evict list so follower cache
+        # mirrors free the same pages (a handler-thread free would
+        # silently desync the fleet).
+        cancelled = [s for s in self.scheduler.evict_cancelled()
+                     if self.cache.length(s) >= 0]
+        for slot in cancelled:
+            self.cache.free_slot(slot)
         active = self.scheduler.active()
         # Page allocation (the host-side step that can raise — out of
         # pages) runs BEFORE the decode announcement: once a follower
@@ -418,8 +429,8 @@ class InferenceEngine:
                 "last": {s: int(self._last_token[s])
                          for s, _ in active},
                 "decode": [s for s, _ in active],
-                "evict": [s for s, _ in admitted
-                          if self.cache.length(s) < 0]})
+                "evict": cancelled + [s for s, _ in admitted
+                                      if self.cache.length(s) < 0]})
         if active:
             self._decode_iteration(active)
         return bool(admitted or active)
@@ -692,6 +703,20 @@ class InferenceEngine:
             if not self._drained:
                 self.scheduler.resume()
         return drained + pending
+
+    def abort_request(self, req: Request,
+                      reason: str = FinishReason.CLIENT_DISCONNECT
+                      ) -> str:
+        """Clean abort of ONE request (the /generate client vanished,
+        hvd-chaos hardening): a queued request finishes immediately; an
+        active one is marked and evicted by the serve loop at its next
+        iteration boundary — the existing eviction path, so the KV slot
+        is released identically on every rank.  Returns the scheduler's
+        "queued"/"active"/"gone" disposition."""
+        disposition = self.scheduler.cancel(req, reason)
+        _flight.record("serve_abort_request", req.rid, reason,
+                       disposition)
+        return disposition
 
     def import_requests(self, exported: List[dict]) -> List[Request]:
         """Resubmit a drained export (relaunch path).  Continuation

@@ -26,6 +26,7 @@ only shared state.
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
 from typing import Optional, Tuple
@@ -51,7 +52,19 @@ _FAILURE_STATUS = {
     FinishReason.DRAINED: (
         503, "generation interrupted by a serving-fleet drain; retry "
              "against the relaunched fleet"),
+    # 499 (nginx convention): the client closed before the response;
+    # nobody reads this body, but a late/raced completion must not
+    # render as a 200.
+    FinishReason.CLIENT_DISCONNECT: (
+        499, "client disconnected mid-generation; slot released"),
 }
+
+_M_CLIENT_DISCONNECTS = _telemetry.counter(
+    "serving.client_disconnects", "clients that vanished mid-generate "
+    "(slot released via the abort path)")
+_M_CP_LOSSES = _telemetry.counter(
+    "serving.control_plane_losses", "serve loops degraded to 503+drain "
+    "after a persistent control-plane loss")
 
 
 def encode_text(text: str, vocab_size: int) -> list:
@@ -113,8 +126,11 @@ class LMServer:
         # the instant the process answers, not a 404 window.
         routes.register_health(HEALTH_KEY, self.engine.health)
         self.engine.warm_start(warm_start_dir)
+        # pass_client: the blocking /generate handler watches its
+        # client connection and aborts the slot when it vanishes
+        # (hvd-chaos hardening; exporter.ClientProbe).
         routes.register(GENERATE_PATH, self._handle_generate,
-                        methods=("POST",))
+                        methods=("POST",), pass_client=True)
         if self._shared_exporter() is None and self._port is not None:
             self._own_exporter = _exporter.start_exporter(
                 _telemetry.registry(), self._port, host=self._host)
@@ -143,8 +159,41 @@ class LMServer:
         self.close()
 
     # -- the serve loop ----------------------------------------------------
+    def _control_plane_lost(self) -> bool:
+        """Persistent control-plane loss: the runtime poisoned itself
+        (a peer died / the reconnect grace expired).  Serving over the
+        training mesh cannot make progress past this — degrade instead
+        of wedging (hvd-chaos no-hang contract)."""
+        try:
+            from ..core import state as _state
+
+            st = _state.global_state()
+            return bool(st.initialized and st.multiprocess
+                        and st.peer_shutdown)
+        except Exception:  # noqa: BLE001 — serving works without init
+            return False
+
     def _loop(self) -> None:
+        degraded = False
         while not self._stop.is_set():
+            if not degraded and self._control_plane_lost():
+                # Graceful degradation, once: stop admission (new
+                # /generate → 503), evict in-flight sequences as
+                # DRAINED (their blocked handlers answer 503 instead
+                # of hanging to the client timeout), and flip /healthz
+                # NOT_READY so the load balancer drains traffic.
+                degraded = True
+                _M_CP_LOSSES.inc()
+                _telemetry.error_event(
+                    "hvd-serve: control plane lost; draining and "
+                    "reporting NOT_READY (503) until relaunch")
+                try:
+                    self.engine.drain()
+                except Exception as e:  # noqa: BLE001 — degradation
+                    # must not kill the loop it is protecting
+                    _telemetry.exception_event(
+                        "serve-degrade", f"{type(e).__name__}: {e}")
+                self.engine.mark_unready()
             if self.engine.scheduler.idle():
                 # Park until a submission wakes us; short timeout so a
                 # racing submit-after-idle-check is picked up anyway.
@@ -179,8 +228,8 @@ class LMServer:
                     self.engine.mark_unready()
 
     # -- /generate ---------------------------------------------------------
-    def _handle_generate(self, query: str,
-                         body: bytes) -> Tuple[int, bytes, str]:
+    def _handle_generate(self, query: str, body: bytes,
+                         client=None) -> Tuple[int, bytes, str]:
         try:
             payload = json.loads(body.decode() or "{}")
         except ValueError:
@@ -219,12 +268,34 @@ class LMServer:
         self._wake.set()
         timeout = float(payload.get("timeout", 120.0))
         t0 = time.perf_counter()
-        try:
-            out = req.result(timeout=timeout)
-        except TimeoutError:
-            return (504, json.dumps(
-                {"error": "generation timed out", "rid": req.rid}
-            ).encode(), "application/json")
+        # Block for the completion in short slices, watching the client
+        # connection between slices: a client that disconnected
+        # mid-generation releases its slot through the abort path
+        # instead of burning decode iterations on tokens nobody will
+        # read (hvd-chaos hardening; counted below).
+        deadline = t0 + timeout
+        while True:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                return (504, json.dumps(
+                    {"error": "generation timed out", "rid": req.rid}
+                ).encode(), "application/json")
+            try:
+                out = req.result(timeout=min(0.2, remaining))
+                break
+            except TimeoutError:
+                if client is not None and client.disconnected():
+                    _M_CLIENT_DISCONNECTS.inc()
+                    disposition = self.engine.abort_request(req)
+                    print(f"[hvd-serve] client of request {req.rid} "
+                          f"disconnected mid-generation; slot "
+                          f"released ({disposition})", file=sys.stderr)
+                    self._wake.set()  # let the loop evict promptly
+                    # The body goes nowhere (the client is gone); the
+                    # status keeps the access path honest.
+                    return (499, json.dumps(
+                        {"error": "client disconnected",
+                         "rid": req.rid}).encode(), "application/json")
         fail = _FAILURE_STATUS.get(req.finish_reason)
         if fail is not None:
             # Failures are explicit statuses, never a 200 that only

@@ -131,6 +131,16 @@ def error_event(message: str) -> None:
         _M_DUMPS.inc()
 
 
+def transport_fault_event(reason: str, detail: str) -> None:
+    """A control-plane fault boundary fired (hvd-chaos hardening):
+    a peer disconnect entering its grace window, a completed session
+    resume, a frame deadline.  Recorded AND dumped — the ring's tail is
+    the forensic record naming the fault (tests assert on it)."""
+    flight.record("transport_fault", reason, detail)
+    if flight.dump(reason, extra={"detail": detail}) is not None:
+        _M_DUMPS.inc()
+
+
 def exception_event(where: str, text: str) -> None:
     flight.record("exception", where, text)
     if flight.dump(f"exception-{where}",

@@ -33,21 +33,59 @@ plane cannot wedge the health endpoint (that is the point of it).
 from __future__ import annotations
 
 import json
+import select
+import socket as _socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
 from ..analysis import lockorder as _lockorder
+from . import flight as _flight
 from .registry import MetricsRegistry
 
 _PROM_HELP_TYPES = {"counter": "counter", "gauge": "gauge",
                     "histogram": "histogram"}
 
 # A route handler: (query_string, request_body) -> (status, body, ctype).
-RouteHandler = Callable[[str, bytes], Tuple[int, bytes, str]]
+# Routes registered with pass_client=True receive a third argument, a
+# :class:`ClientProbe`, so a long-blocking handler (serving's
+# /generate) can notice its client vanished and abort the work instead
+# of generating tokens nobody will read (hvd-chaos hardening).
+RouteHandler = Callable[..., Tuple[int, bytes, str]]
 # A health contributor: () -> (ready, payload_dict) — payload is merged
 # into the /healthz JSON under the contributor's name.
 HealthContributor = Callable[[], Tuple[bool, dict]]
+
+
+class ClientProbe:
+    """Liveness probe for one HTTP client connection.
+
+    ``disconnected()`` is a zero-timeout ``select`` + ``MSG_PEEK``: a
+    readable socket returning EOF means the client closed mid-request
+    (an HTTP/1.1 client sends nothing after its request, so readable
+    data that is NOT EOF — a pipelined request — reads as still
+    connected).  The hvd-chaos ``serving.disconnect`` site injects a
+    positive answer here, which is exactly where a real disconnect is
+    observed."""
+
+    def __init__(self, sock: Optional[_socket.socket]) -> None:
+        self._sock = sock
+
+    def disconnected(self) -> bool:
+        from .. import chaos as _chaos
+
+        if _chaos.active() and _chaos.fire("serving.disconnect") \
+                is not None:
+            return True
+        if self._sock is None:
+            return False
+        try:
+            readable, _, _ = select.select([self._sock], [], [], 0)
+            if not readable:
+                return False
+            return self._sock.recv(1, _socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
 
 
 def prometheus_name(name: str) -> str:
@@ -89,17 +127,22 @@ class RouteRegistry:
 
     def __init__(self) -> None:
         self._lock = _lockorder.make_lock("exporter.RouteRegistry._lock")
-        self._routes: Dict[Tuple[str, str], RouteHandler] = {}
+        # (method, path) -> (handler, pass_client)
+        self._routes: Dict[Tuple[str, str],
+                           Tuple[RouteHandler, bool]] = {}
         # guarded_by: _lock
         self._health: Dict[str, HealthContributor] = {}  # guarded_by: _lock
 
     def register(self, path: str, handler: RouteHandler,
-                 methods: Tuple[str, ...] = ("GET",)) -> None:
+                 methods: Tuple[str, ...] = ("GET",),
+                 pass_client: bool = False) -> None:
         """Bind ``handler`` to ``path`` for ``methods`` (replaces any
-        previous binding — re-init idempotency)."""
+        previous binding — re-init idempotency).  ``pass_client=True``
+        hands the handler a :class:`ClientProbe` third argument so it
+        can watch for a mid-request client disconnect."""
         with self._lock:
             for m in methods:
-                self._routes[(m.upper(), path)] = handler
+                self._routes[(m.upper(), path)] = (handler, pass_client)
 
     def unregister(self, path: str) -> None:
         with self._lock:
@@ -117,7 +160,9 @@ class RouteRegistry:
         with self._lock:
             self._health.pop(name, None)
 
-    def lookup(self, method: str, path: str) -> Optional[RouteHandler]:
+    def lookup(self, method: str,
+               path: str) -> Optional[Tuple[RouteHandler, bool]]:
+        """(handler, pass_client) for a bound route, else None."""
         with self._lock:
             return self._routes.get((method.upper(), path))
 
@@ -179,11 +224,22 @@ class MetricsExporter:
 
             def _reply(self, code: int, body: bytes,
                        ctype: str) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError) as e:
+                    # The client vanished between the handler finishing
+                    # and the response write (hvd-chaos hardening):
+                    # nothing to deliver to, and one gone client must
+                    # never take the server thread down with a
+                    # traceback.  The handler-side ClientProbe catches
+                    # MID-request disconnects; this catches the
+                    # at-reply race.
+                    _flight.record("client_gone_at_reply", self.path,
+                                   f"{type(e).__name__}")
 
             def _dispatch(self, method: str, body: bytes) -> None:
                 path, _, query = self.path.partition("?")
@@ -203,12 +259,17 @@ class MetricsExporter:
                             200, prometheus_text(snap).encode(),
                             "text/plain; version=0.0.4")
                     return
-                handler = exporter.routes.lookup(method, path)
-                if handler is None:
+                bound = exporter.routes.lookup(method, path)
+                if bound is None:
                     self._reply(404, b"not found\n", "text/plain")
                     return
+                handler, pass_client = bound
                 try:
-                    code, out, ctype = handler(query, body)
+                    if pass_client:
+                        code, out, ctype = handler(
+                            query, body, ClientProbe(self.connection))
+                    else:
+                        code, out, ctype = handler(query, body)
                 except Exception as e:  # noqa: BLE001 — one bad request
                     # must not kill the server thread
                     self._reply(500, json.dumps(

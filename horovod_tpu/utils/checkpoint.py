@@ -37,6 +37,7 @@ from __future__ import annotations
 import atexit
 import os
 import queue
+import sys
 import threading
 import time
 from typing import Any, Optional
@@ -44,16 +45,30 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from .. import chaos as _chaos
 from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
 from ..core import state as _state
 from ..parallel.data import broadcast_parameters
+from ..telemetry import flight as _flight
+from .retry import BackoffPolicy, retry_call
 
 _M_WRITE_SECONDS = _telemetry.histogram(
     "checkpoint.write_seconds", "seconds",
     "disk seconds per background checkpoint write")
 _M_PENDING = _telemetry.gauge(
     "checkpoint.pending", "checkpoint writes queued or in flight")
+_M_RETRIES = _telemetry.counter(
+    "checkpoint.retries", "transient write failures retried with "
+    "backoff before surfacing CheckpointError (hvd-chaos hardening)")
+
+
+def _write_retries() -> int:
+    """Attempts per checkpoint publish (1 = the pre-chaos no-retry
+    behavior).  A transient OSError — flaky NFS, a momentary ENOSPC —
+    should not permanently fail a CheckpointWrite that a retry 50 ms
+    later would land."""
+    return max(1, int(os.environ.get("HVD_TPU_CKPT_RETRIES", "3")))
 
 
 class CheckpointError(RuntimeError):
@@ -96,14 +111,55 @@ class CheckpointWrite:
         return True
 
 
-def _write_bytes(path: str, blob: bytes) -> None:
-    """Atomic publish: full write to a private tmp, then rename.  A
-    crash at ANY point leaves either the previous file or the new one —
-    never a torn read (tests kill this midway to prove it)."""
+def _write_bytes_once(path: str, blob: bytes) -> None:
+    """One atomic publish attempt: full write to a private tmp, then
+    rename.  A crash at ANY point leaves either the previous file or
+    the new one — never a torn read (tests kill this midway to prove
+    it).  The hvd-chaos ``ckpt.oserror`` site injects its transient
+    OSError here — inside the retried region, before the rename — so
+    an injected fault can never publish partial bytes either."""
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as f:
+            fault = _chaos.fire("ckpt.oserror") if _chaos.active() \
+                else None
+            if fault is not None:
+                raise OSError(28, "hvd-chaos: ckpt.oserror (injected "
+                              "transient ENOSPC)", tmp)
+            f.write(blob)
+        os.replace(tmp, path)
+    except BaseException:
+        # A failed attempt must not strand its tmp: the NEXT attempt
+        # re-creates it, and the atomicity story stays "previous file
+        # or new file, never torn, at most one orphaned tmp".
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _write_bytes(path: str, blob: bytes) -> None:
+    """Atomic publish with transient-fault retries (hvd-chaos
+    hardening): up to ``HVD_TPU_CKPT_RETRIES`` attempts with the shared
+    jittered exponential backoff (utils/retry.py); each retried failure
+    is counted, flight-recorded and logged.  Only OSError retries —
+    serialization bugs fail immediately.  The final failure re-raises
+    unchanged, keeping the CheckpointError contract at ``wait()``."""
+
+    def on_retry(attempt: int, exc: BaseException, delay: float) -> None:
+        _M_RETRIES.inc()
+        _flight.record("ckpt_retry", path, attempt,
+                       f"{type(exc).__name__}: {exc}")
+        print(f"WARNING: checkpoint write to {path!r} failed "
+              f"(attempt {attempt + 1}/{_write_retries()}: "
+              f"{type(exc).__name__}: {exc}); retrying in "
+              f"{delay * 1e3:.0f}ms", file=sys.stderr)
+
+    retry_call(lambda: _write_bytes_once(path, blob),
+               attempts=_write_retries(),
+               policy=BackoffPolicy(base=0.02, cap=0.5),
+               retry_on=(OSError,), on_retry=on_retry)
 
 
 class _Writer:

@@ -1,0 +1,673 @@
+"""hvd-chaos (ISSUE 9): fault-spec grammar + deterministic replay, the
+shared backoff policy, the transport session-resume protocol (replay
+rings, reconnect, grace, frame deadlines), checkpoint-writer retries,
+the serving client-disconnect abort path, and the scenario matrix's
+shape — with the satellite assertions that the flight-recorder dumps'
+tails NAME each injected fault class."""
+
+import glob
+import json
+import os
+import random
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+import horovod_tpu.chaos as chaos
+from horovod_tpu.chaos import spec as chaos_spec
+from horovod_tpu.utils.retry import BackoffPolicy, retry_call
+
+THRESHOLD = 1 << 20
+
+
+@pytest.fixture()
+def chaos_env(monkeypatch):
+    """Arm/disarm HVD_TPU_FAULTS around a test and always restore the
+    unarmed module state afterwards."""
+
+    def arm(spec_text):
+        monkeypatch.setenv("HVD_TPU_FAULTS", spec_text)
+        return chaos.reload()
+
+    yield arm
+    monkeypatch.delenv("HVD_TPU_FAULTS", raising=False)
+    chaos.reload()
+
+
+# ---------------------------------------------------------------------------
+# Grammar + determinism (the replay contract)
+# ---------------------------------------------------------------------------
+
+def test_parse_grammar_clauses_keys_and_seed():
+    s = chaos_spec.parse(
+        "transport.reset:count=2:after=5:rank=1;"
+        "ckpt.oserror:p=0.5;input.stall:delay=0.25@99")
+    assert s.seed == 99
+    assert s.sites() == ["ckpt.oserror", "input.stall",
+                         "transport.reset"]
+    assert "transport.reset:count=2:after=5:rank=1" in s.describe()
+    assert s.describe().endswith("@99")
+
+
+def test_parse_defaults_bare_clause_fires_once():
+    s = chaos_spec.parse("transport.drop")
+    assert s.fire("transport.drop") is not None
+    assert s.fire("transport.drop") is None  # count defaulted to 1
+
+
+@pytest.mark.parametrize("bad,fragment", [
+    ("transport.explode", "valid sites"),
+    ("transport.drop:zap=1", "valid keys"),
+    ("transport.drop:count=x", "bad value"),
+    ("transport.drop@notanint", "seed"),
+    ("transport.drop:p=1.5", "bad value"),
+])
+def test_parse_errors_name_the_problem(bad, fragment):
+    with pytest.raises(ValueError, match=fragment):
+        chaos_spec.parse(bad)
+
+
+def test_validate_env_rejects_typos(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FAULTS", "transprot.reset@1")
+    with pytest.raises(ValueError, match="valid sites"):
+        chaos.validate_env()
+
+
+def test_same_spec_and_seed_identical_fault_sequence():
+    """The replay acceptance criterion: same spec + seed ⇒ the
+    identical fault sequence, decision by decision."""
+    text = "transport.drop:p=0.3:count=50@1234"
+    a, b = chaos_spec.parse(text), chaos_spec.parse(text)
+    seq_a = [a.fire("transport.drop") is not None for _ in range(400)]
+    seq_b = [b.fire("transport.drop") is not None for _ in range(400)]
+    assert seq_a == seq_b
+    assert any(seq_a)  # and it does fire
+    # A different seed yields a different sequence (p-decisions are
+    # seed-dependent, not wall-clock-dependent).
+    c = chaos_spec.parse("transport.drop:p=0.3:count=50@77")
+    seq_c = [c.fire("transport.drop") is not None for _ in range(400)]
+    assert seq_a != seq_c
+
+
+def test_count_after_and_rank_filters():
+    s = chaos_spec.parse("transport.reset:count=2:after=3:rank=1@0")
+    # rank mismatch: never fires, opportunities still counted.
+    assert all(s.fire("transport.reset", rank=0) is None
+               for _ in range(10))
+    assert s.opportunities("transport.reset") == 10
+    s = chaos_spec.parse("transport.reset:count=2:after=3:rank=1@0")
+    fired = [s.fire("transport.reset", rank=1) is not None
+             for _ in range(10)]
+    assert fired == [False] * 3 + [True, True] + [False] * 5
+
+
+def test_maybe_reorder_is_deterministic(chaos_env):
+    chaos_env("coord.reorder:count=1@5")
+    assert chaos.maybe_reorder("coord.reorder", [1, 2, 3]) == [3, 2, 1]
+    assert chaos.maybe_reorder("coord.reorder", [1, 2, 3]) == [1, 2, 3]
+
+
+def test_unarmed_fire_is_none(chaos_env):
+    chaos.reload()
+    assert chaos.fire("transport.drop") is None
+    assert not chaos.active()
+
+
+# ---------------------------------------------------------------------------
+# Shared backoff policy (utils/retry.py)
+# ---------------------------------------------------------------------------
+
+def test_backoff_policy_jitter_bounds_and_cap():
+    p = BackoffPolicy(base=0.1, cap=1.0, rng=random.Random(7))
+    for k in range(12):
+        d = p.delay(k)
+        assert 0.0 <= d <= min(1.0, 0.1 * 2 ** k)
+    # The ceiling grows then saturates at the cap.
+    ceilings = [min(1.0, 0.1 * 2 ** k) for k in range(12)]
+    assert ceilings[-1] == 1.0
+
+
+def test_backoff_policy_rejects_nonsense():
+    with pytest.raises(ValueError):
+        BackoffPolicy(base=0.0)
+    with pytest.raises(ValueError):
+        BackoffPolicy(base=1.0, cap=0.5)
+
+
+def test_retry_call_retries_then_succeeds_and_reports():
+    calls = {"n": 0}
+    seen = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(28, "flaky")
+        return "ok"
+
+    out = retry_call(flaky, attempts=4,
+                     policy=BackoffPolicy(base=0.001, cap=0.002),
+                     on_retry=lambda a, e, d: seen.append((a, str(e))))
+    assert out == "ok" and calls["n"] == 3
+    assert [a for a, _ in seen] == [0, 1]
+
+
+def test_retry_call_exhaustion_reraises_original():
+    with pytest.raises(OSError, match="always"):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("always")),
+                   attempts=3,
+                   policy=BackoffPolicy(base=0.001, cap=0.002))
+
+
+# ---------------------------------------------------------------------------
+# Replay ring (ops/transport.py)
+# ---------------------------------------------------------------------------
+
+def test_frame_ring_since_and_overflow():
+    from horovod_tpu.ops.transport import _FrameRing
+
+    r = _FrameRing(limit=4)
+    for i in range(6):
+        r.append(8, bytes([i]))
+    assert r.count == 6
+    # The peer received 3 frames: frames 3..5 are the missing suffix.
+    assert [p for _, p in r.since(3)] == [b"\x03", b"\x04", b"\x05"]
+    assert r.since(6) == []          # fully caught up
+    assert r.since(1) is None        # gap beyond the ring: unplayable
+    assert r.since(7) is None        # claims more than ever sent
+
+
+# ---------------------------------------------------------------------------
+# Transport session resume over real sockets (no XLA)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def cp_pair():
+    """A controller + worker transport pair over loopback with live
+    response-cache replicas — the test_cache two-rank harness, kept as
+    a fixture so every reconnect test reuses one teardown path."""
+    from horovod_tpu.ops import cache as hvd_cache
+    from horovod_tpu.ops import transport as T
+    from horovod_tpu.ops.coordinator import Coordinator
+
+    if os.environ.get("HVD_TPU_NO_SOCKETS") == "1":
+        pytest.skip("sandbox without loopback sockets")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ctrl_cache = hvd_cache.ResponseCache(rank=0)
+    coord = Coordinator(size=2, fusion_threshold=THRESHOLD,
+                        cache=ctrl_cache)
+    holder = {}
+    th = threading.Thread(
+        target=lambda: holder.__setitem__(
+            "ctrl", T.ControllerTransport(coord, 2, port)),
+        daemon=True)
+    th.start()
+    time.sleep(0.1)
+    worker = T.WorkerTransport("127.0.0.1", port, 1)
+    th.join(timeout=10.0)
+    ctrl = holder["ctrl"]
+    ctrl.cache = ctrl_cache
+    worker.cache = hvd_cache.ResponseCache(rank=1)
+    yield ctrl, worker, coord, ctrl_cache
+    worker.close()
+    ctrl.close()
+    coord.close()
+
+
+def _cp_request(rank, name):
+    from horovod_tpu.ops import wire
+    from horovod_tpu.ops.wire import Request
+
+    return Request(rank, wire.RequestType.ALLREDUCE,
+                   wire.DataType.FLOAT32, name, -1, -1, (4,),
+                   wire.ReduceOp.SUM, 0, ())
+
+
+def _controller_tick(ctrl, coord, cache):
+    ctrl.expire_grace()
+    ctrl.flush_unrouted()
+    marker = cache.take_flush_marker()
+    replayed, groups, epoch, compact = cache.take_ready(
+        lambda psid: THRESHOLD)
+    negotiated = coord.poll_responses({})
+    resps = (([marker] if marker is not None else [])
+             + replayed + negotiated)
+    n_other = (1 if marker is not None else 0) + len(negotiated)
+    if resps:
+        if compact and groups and n_other == 0:
+            ctrl.broadcast_replay(groups, epoch)
+        else:
+            ctrl.broadcast_responses(resps)
+    rid = frozenset(id(r) for r in replayed)
+    for r in resps:
+        cache.observe_response(r, replay=id(r) in rid)
+    return resps
+
+
+def _run_cycle(ctrl, worker, coord, cache, names=("x", "y"),
+               deadline=10.0):
+    """One full negotiation cycle over the wire; returns the worker's
+    received responses.  Tolerates a mid-cycle reconnect (that is the
+    point)."""
+    from horovod_tpu.ops.wire import ResponseType
+
+    wreqs = {}
+    for n in names:
+        req = _cp_request(1, n)
+        wreqs[n] = req
+        worker.submit(req)
+    worker.flush_requests()
+    for n in names:
+        ctrl.submit(_cp_request(0, n))
+    want = set(names)
+    got = []
+    end = time.monotonic() + deadline
+    seen_ctrl = set()
+    while time.monotonic() < end:
+        for r in _controller_tick(ctrl, coord, cache):
+            seen_ctrl.update(r.tensor_names)
+        batch = worker.poll_responses()
+        if batch is not None:
+            for r in batch:
+                assert r.response_type != ResponseType.SHUTDOWN, \
+                    r.error_message
+                wcache = worker.cache
+                if wcache is not None:
+                    wcache.observe_response(r, own_requests={1: wreqs})
+                got.append(r)
+        if want <= {n for r in got for n in r.tensor_names} \
+                and want <= seen_ctrl:
+            return got
+        time.sleep(0.005)
+    raise AssertionError(
+        f"cycle never completed: worker got "
+        f"{[r.tensor_names for r in got]}, controller saw {seen_ctrl}")
+
+
+def test_reconnect_resumes_session_with_ring_replay(cp_pair, tmp_path,
+                                                    monkeypatch,
+                                                    capfd):
+    """The tentpole wire contract: a hard connection reset mid-steady-
+    state is absorbed by reconnect + replay-ring resume; the cache
+    replica stays attached and later cycles still complete; the flight
+    dump's tail names the reconnect (satellite)."""
+    import horovod_tpu.telemetry as tel
+    from horovod_tpu.ops import transport as T
+
+    monkeypatch.setenv("HVD_TPU_FLIGHT_DIR", str(tmp_path))
+    ctrl, worker, coord, cache = cp_pair
+    _run_cycle(ctrl, worker, coord, cache)          # cold
+    _run_cycle(ctrl, worker, coord, cache)          # steady (compact)
+    before = tel.metrics().get("transport.reconnects",
+                               {}).get("value", 0)
+    T._hard_close(worker._sock)                     # the fault
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        _controller_tick(ctrl, coord, cache)  # serve the reconnect era
+        now = tel.metrics().get("transport.reconnects",
+                                {}).get("value", 0)
+        if now > before:
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError("worker never reconnected")
+    assert worker.cache is not None          # replica resumed, not dropped
+    _run_cycle(ctrl, worker, coord, cache)          # post-resume cycle
+    err = capfd.readouterr().err
+    assert "session resumed" in err
+    # Satellite: the dump exists and its tail names the fault class.
+    dumps = sorted(glob.glob(str(tmp_path / "*reconnect*.json")))
+    assert dumps, sorted(glob.glob(str(tmp_path / "*")))
+    payload = json.loads(open(dumps[-1]).read())
+    tail = payload["events"][-10:]
+    assert any(e["kind"] == "reconnected" for e in tail), tail
+    assert any(e["kind"] == "transport_fault"
+               and "reconnect" in e["args"][0] for e in tail), tail
+
+
+def test_reconnect_epoch_mismatch_resumes_cache_less(cp_pair, capfd):
+    """The epoch-stamped handshake: a worker whose replica epoch no
+    longer matches the disconnect-time epoch must resume CACHE-LESS
+    (and the controller flushes so no compact frame strands it) —
+    desync is impossible by construction, and cycles still
+    complete."""
+    import horovod_tpu.telemetry as tel
+    from horovod_tpu.ops import transport as T
+
+    ctrl, worker, coord, cache = cp_pair
+    _run_cycle(ctrl, worker, coord, cache)
+    _run_cycle(ctrl, worker, coord, cache)
+    # Locally desync the worker's replica epoch (a flush rank 0 never
+    # broadcast — the exact state the verdict must catch).
+    worker.cache.flush("test-induced desync")
+    before = tel.metrics().get("transport.reconnects",
+                               {}).get("value", 0)
+    T._hard_close(worker._sock)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        _controller_tick(ctrl, coord, cache)
+        if tel.metrics().get("transport.reconnects",
+                             {}).get("value", 0) > before:
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError("worker never reconnected")
+    assert worker.cache is None              # dropped, not desynced
+    _run_cycle(ctrl, worker, coord, cache)   # full-response broadcasts
+    err = capfd.readouterr().err
+    assert "resuming cache-less" in err
+    assert "cache epoch" in err
+
+
+def test_frame_deadline_names_peer_and_frame_type(monkeypatch, capfd):
+    """Satellite: frame-level read deadlines produce a diagnostic
+    naming the peer and the frame type, never a hang."""
+    monkeypatch.setenv("HVD_TPU_FRAME_TIMEOUT", "0.4")
+    from horovod_tpu.ops import cache as hvd_cache
+    from horovod_tpu.ops import transport as T
+    from horovod_tpu.ops.coordinator import Coordinator
+    from horovod_tpu.telemetry import flight
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    coord = Coordinator(size=2, fusion_threshold=THRESHOLD)
+    holder = {}
+    th = threading.Thread(
+        target=lambda: holder.__setitem__(
+            "ctrl", T.ControllerTransport(coord, 2, port)),
+        daemon=True)
+    th.start()
+    time.sleep(0.1)
+    worker = T.WorkerTransport("127.0.0.1", port, 1)
+    th.join(timeout=10.0)
+    ctrl = holder["ctrl"]
+    try:
+        # A REQUEST_BATCH header promising 100 bytes, then silence:
+        # the controller's mid-frame deadline must fire.
+        worker._sock.sendall(struct.pack("<IB", 100, 8) + b"xx")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if any(e[1] == "frame_timeout" for e in flight.snapshot()):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("frame deadline never fired")
+        err = capfd.readouterr().err
+        assert "frame deadline exceeded" in err
+        assert "rank 1" in err
+        assert "REQUEST_BATCH" in err
+    finally:
+        worker.close()
+        ctrl.close()
+        coord.close()
+
+
+def test_truncated_frame_is_named(cp_pair, capfd):
+    """Satellite: a frame cut off mid-wire is recorded as a truncated
+    frame naming the peer and frame type (the reconnect machinery then
+    recovers it — covered above)."""
+    from horovod_tpu.telemetry import flight
+
+    ctrl, worker, coord, cache = cp_pair
+    _run_cycle(ctrl, worker, coord, cache)
+    # Promise 64 payload bytes, deliver 3, then reset the socket.
+    worker._sock.sendall(struct.pack("<IB", 64, 8) + b"abc")
+    from horovod_tpu.ops import transport as T
+
+    T._hard_close(worker._sock)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if any(e[1] == "truncated_frame" for e in flight.snapshot()):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("truncation never recorded")
+    err = capfd.readouterr().err
+    assert "truncated control frame" in err
+    assert "REQUEST_BATCH" in err
+
+
+def test_reconnect_exhaustion_poisons_with_named_diagnostic(
+        cp_pair, monkeypatch, capfd):
+    """The bounded end of the no-hang contract on the worker side: a
+    controller that never comes back exhausts the reconnect deadline
+    and pending ops fail with a diagnostic naming the fault."""
+    from horovod_tpu.ops import transport as T
+    from horovod_tpu.ops.wire import ResponseType
+
+    monkeypatch.setenv("HVD_TPU_RECONNECT_DEADLINE", "1.0")
+    # The poison path disarms jax.distributed's exit barrier — a
+    # process-global latch this harness (which never initialized
+    # jax.distributed) must re-arm for later in-process hvd.init()s.
+    from horovod_tpu.core import cluster as _cluster
+
+    monkeypatch.setattr(_cluster, "_disarmed", _cluster._disarmed)
+    ctrl, worker, coord, cache = cp_pair
+    _run_cycle(ctrl, worker, coord, cache)
+    ctrl.close()  # the controller is gone for good
+    T._hard_close(worker._sock)
+    deadline = time.monotonic() + 15.0
+    got = None
+    while time.monotonic() < deadline:
+        resps = worker.poll_responses()
+        if resps and any(r.response_type == ResponseType.SHUTDOWN
+                         for r in resps):
+            got = [r for r in resps
+                   if r.response_type == ResponseType.SHUTDOWN][0]
+            break
+        time.sleep(0.02)
+    assert got is not None, "worker never poisoned its pending ops"
+    assert "no reconnect within" in got.error_message, got.error_message
+
+
+def test_grace_expiry_declares_rank_lost_with_reason(cp_pair,
+                                                     monkeypatch):
+    """Controller side of the bounded contract: a disconnected rank
+    that never resumes becomes a lost rank once the grace window
+    expires, with a reason naming the fault."""
+    monkeypatch.setenv("HVD_TPU_RECONNECT_GRACE", "0.3")
+    ctrl, worker, coord, cache = cp_pair
+    _run_cycle(ctrl, worker, coord, cache)
+    worker.close()  # no SHUTDOWN frame, no reconnect ever
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        ctrl.expire_grace()
+        if ctrl.lost_ranks:
+            break
+        time.sleep(0.05)
+    assert ctrl.lost_ranks == {1}
+    assert "no reconnect within" in ctrl.lost_reasons[1]
+
+
+def test_connect_backoff_logs_attempts_with_remaining_deadline(capfd):
+    """Satellite: the initial connect loop uses the shared jittered
+    backoff and logs every attempt with the remaining deadline."""
+    from horovod_tpu.ops import transport as T
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="could not reach"):
+        T.WorkerTransport("127.0.0.1", port, 3, connect_timeout=0.7)
+    assert time.monotonic() - t0 < 10.0
+    err = capfd.readouterr().err
+    assert "[hvd-connect] rank 3" in err
+    assert "before deadline" in err
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint writer retries (utils/checkpoint.py)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_transient_oserror_retries_then_lands(
+        chaos_env, tmp_path, capfd):
+    import numpy as np
+
+    import horovod_tpu.telemetry as tel
+    from horovod_tpu.utils import checkpoint as ckpt
+
+    chaos_env("ckpt.oserror:count=2@3")
+    before = tel.metrics().get("checkpoint.retries",
+                               {}).get("value", 0)
+    tree = {"w": np.arange(16, dtype=np.float32)}
+    handle = ckpt.write_tree_async(str(tmp_path / "m.msgpack"), tree,
+                                   step=4)
+    assert handle.wait(timeout=30.0)
+    assert (tmp_path / "m.msgpack").exists()
+    assert (tmp_path / "m.msgpack.step").read_text() == "4"
+    after = tel.metrics().get("checkpoint.retries",
+                              {}).get("value", 0)
+    assert after - before >= 2
+    assert "retrying" in capfd.readouterr().err
+    # Atomicity held: no stranded tmp files.
+    assert not glob.glob(str(tmp_path / "*.tmp.*"))
+
+
+def test_checkpoint_retry_exhaustion_dump_names_fault(
+        chaos_env, tmp_path, monkeypatch):
+    """Satellite: retry exhaustion raises CheckpointError naming the
+    injected fault, and the flight dump's tail records the retries and
+    the final error."""
+    import numpy as np
+
+    from horovod_tpu.utils import checkpoint as ckpt
+
+    monkeypatch.setenv("HVD_TPU_CKPT_RETRIES", "2")
+    flight_dir = tmp_path / "flight"
+    monkeypatch.setenv("HVD_TPU_FLIGHT_DIR", str(flight_dir))
+    chaos_env("ckpt.oserror:count=9@4")
+    tree = {"w": np.arange(8, dtype=np.float32)}
+    handle = ckpt.write_tree_async(str(tmp_path / "m.msgpack"), tree)
+    with pytest.raises(ckpt.CheckpointError, match="ckpt.oserror"):
+        handle.wait(timeout=30.0)
+    dumps = sorted(glob.glob(str(flight_dir / "*checkpoint-error*")))
+    assert dumps, sorted(glob.glob(str(flight_dir / "*")))
+    payload = json.loads(open(dumps[-1]).read())
+    tail = payload["events"][-10:]
+    assert any(e["kind"] == "ckpt_retry" for e in tail), tail
+    assert any(e["kind"] == "checkpoint_error"
+               and any("ckpt.oserror" in str(a) for a in e["args"])
+               for e in tail), tail
+
+
+# ---------------------------------------------------------------------------
+# Prefetch stall injection (parallel/input.py)
+# ---------------------------------------------------------------------------
+
+def test_input_stall_injection_preserves_order_and_values(chaos_env,
+                                                          hvd):
+    import numpy as np
+
+    chaos_env("input.stall:count=2:delay=0.1@6")
+    batches = [np.full((8, 2), float(i), np.float32) for i in range(6)]
+    out = [np.asarray(b)[0, 0] for b in
+           hvd.prefetch_to_device(iter(batches))]
+    assert out == [float(i) for i in range(6)]
+    assert chaos.schedule().opportunities("input.stall") >= 6
+
+
+# ---------------------------------------------------------------------------
+# Serving: scheduler cancel + client-disconnect abort path
+# ---------------------------------------------------------------------------
+
+def test_scheduler_cancel_queued_finishes_immediately():
+    from horovod_tpu.serving import (ContinuousBatchingScheduler,
+                                     FinishReason, Request)
+
+    s = ContinuousBatchingScheduler(max_slots=1, capacity=32)
+    r1 = s.submit(Request(prompt=[1, 2], max_new_tokens=4))
+    r2 = s.submit(Request(prompt=[3, 4], max_new_tokens=4))
+    s.admit()
+    assert s.cancel(r2, FinishReason.CLIENT_DISCONNECT) == "queued"
+    assert r2.done.is_set()
+    assert r2.finish_reason == FinishReason.CLIENT_DISCONNECT
+    assert s.queue_depth() == 0
+    # Active request: marked, evicted at the loop boundary.
+    assert s.cancel(r1, FinishReason.CLIENT_DISCONNECT) == "active"
+    assert not r1.done.is_set()
+    assert s.evict_cancelled() == [0]
+    assert r1.done.is_set()
+    assert s.occupancy() == 0
+    assert s.cancel(r1, FinishReason.CLIENT_DISCONNECT) == "gone"
+
+
+def test_client_probe_detects_closed_socket():
+    from horovod_tpu.telemetry.exporter import ClientProbe
+
+    a, b = socket.socketpair()
+    probe = ClientProbe(a)
+    assert not probe.disconnected()
+    b.close()
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and not probe.disconnected():
+        time.sleep(0.01)
+    assert probe.disconnected()
+    a.close()
+
+
+def test_route_registry_pass_client_flag():
+    from horovod_tpu.telemetry.exporter import RouteRegistry
+
+    reg = RouteRegistry()
+    reg.register("/a", lambda q, b: (200, b"", "t"))
+    reg.register("/b", lambda q, b, c: (200, b"", "t"),
+                 methods=("POST",), pass_client=True)
+    assert reg.lookup("GET", "/a")[1] is False
+    assert reg.lookup("POST", "/b")[1] is True
+
+
+# ---------------------------------------------------------------------------
+# The matrix's shape (the CI gate's coverage contract)
+# ---------------------------------------------------------------------------
+
+def test_matrix_covers_every_injection_point():
+    """ISSUE 9 acceptance: at least one matrix entry per injection
+    point — transport, coordinator, checkpoint, prefetch, serving."""
+    from horovod_tpu.chaos import matrix
+
+    families = set()
+    for s in matrix.SCENARIOS:
+        assert s.expect in ("recover", "diagnostic", "complete"), s
+        assert s.cap > 0
+        for clause in filter(None, s.spec.rpartition("@")[0].split(";")):
+            families.add(clause.split(":")[0].split(".")[0])
+        if s.name == "grace_expiry":
+            families.add("transport")  # the fault is the hard kill
+        if s.name == "serving_storm":
+            families.add("serving")    # the fault is the load
+    assert {"transport", "coord", "ckpt", "input",
+            "serving"} <= families, families
+    # Every spec parses (a typo'd matrix entry must fail HERE, not in
+    # CI's chaos job).
+    for s in matrix.SCENARIOS:
+        if s.spec:
+            chaos_spec.parse(s.spec)
+
+
+def test_matrix_digest_and_result_parsing():
+    from horovod_tpu.chaos import matrix
+
+    d1 = matrix._digest([(1, "a"), (0, "b")])
+    d2 = matrix._digest([(0, "b"), (1, "a")])
+    assert d1 == d2  # order-insensitive
+    assert d1 != matrix._digest([(0, "b")])
+    out = "noise\nCHAOS_RESULT rank=1 n=3 digest=abc\nmore"
+    assert matrix._parse_results(out) == {1: "n=3 digest=abc"}
+
+
+def test_matrix_smoke_one_cp_scenario():
+    """End-to-end runner mechanics on the cheapest scenario: real
+    subprocesses, wall-clock cap, diagnostic assertion."""
+    from horovod_tpu.chaos import matrix
+
+    report = matrix.run_scenario(matrix.find("grace_expiry"))
+    assert report["status"] == "PASS", report

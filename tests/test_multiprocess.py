@@ -279,6 +279,24 @@ def test_elastic_survives_two_sequential_deaths(tmp_path):
 
 
 @pytest.mark.slow
+def test_chaos_reconnect_mid_training_bitwise(tmp_path):
+    """hvd-chaos acceptance (ISSUE 9): rank 1's control-plane
+    connection is hard-reset mid-training; the worker reconnects with
+    backoff, the session-resume handshake replays the lost frames, and
+    the trained weights are BITWISE-identical to the uninterrupted
+    arithmetic (asserted inside tests/mp_worker.py scenario_chaos).
+    Like every mp data-plane leg this needs a jax with np>1 CPU
+    collectives (CI's jax; the container's 0.4.37 cannot)."""
+    flight_dir = tmp_path / "flight"
+    out = _launch("chaos", timeout=300.0, extra_env={
+        "HVD_TPU_FLIGHT_DIR": str(flight_dir)})
+    assert "CHAOS_MP_OK rank=0" in out, out
+    assert "CHAOS_MP_OK rank=1" in out, out
+    # The reconnect really happened (not a silently-intact socket).
+    assert "[hvd-reconnect] rank 1: session resumed" in out, out
+
+
+@pytest.mark.slow
 def test_response_cache_two_processes():
     """Steady-state negotiation bypass across REAL processes
     (ops/cache.py): coalesced bit-vector request frames, compact replay

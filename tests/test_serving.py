@@ -808,6 +808,60 @@ def test_lmserver_midflight_drain_returns_retryable_503():
         assert resp2["finish_reason"] == "max_new_tokens"
 
 
+def test_lmserver_client_disconnect_releases_slot(monkeypatch):
+    """hvd-chaos satellite (ISSUE 9): a client that vanishes
+    mid-generation is detected by the handler's ClientProbe (the
+    serving.disconnect injection site), the slot is released through
+    the abort path, serving.client_disconnects counts it, and the SAME
+    slot serves the next request normally."""
+    import horovod_tpu.chaos as chaos
+    import horovod_tpu.telemetry as tel
+
+    engine = make_engine(max_slots=1)
+    with LMServer(engine, port=0) as srv:
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        before = tel.metrics().get("serving.client_disconnects",
+                                   {}).get("value", 0)
+        monkeypatch.setenv("HVD_TPU_FAULTS",
+                           "serving.disconnect:count=1@7")
+        chaos.reload()
+        try:
+            try:
+                _post(base + "/generate",
+                      {"tokens": [1, 2, 3], "max_tokens": 400,
+                       "timeout": 30})
+                pytest.fail("expected HTTP 499 for the gone client")
+            except urllib.error.HTTPError as e:
+                assert e.code == 499
+                resp = json.loads(e.read())
+            assert "disconnected" in resp["error"]
+        finally:
+            monkeypatch.delenv("HVD_TPU_FAULTS", raising=False)
+            chaos.reload()
+        after = tel.metrics().get("serving.client_disconnects",
+                                  {}).get("value", 0)
+        assert after - before >= 1
+        # The slot was released at the loop boundary: the one-slot
+        # engine admits (and completes) a fresh request.
+        status, resp2 = _post(base + "/generate",
+                              {"tokens": [1, 2, 3], "max_tokens": 6,
+                               "timeout": 30})
+        assert status == 200
+        assert resp2["finish_reason"] == "max_new_tokens"
+        deadline = _time_monotonic_deadline(5.0)
+        while engine.scheduler.occupancy() and not deadline():
+            pass
+        assert engine.scheduler.occupancy() == 0
+
+
+def _time_monotonic_deadline(seconds):
+    import time as _t
+
+    end = _t.monotonic() + seconds
+    return lambda: _t.monotonic() > end
+
+
 def test_lmserver_concurrent_http_requests():
     cfg = TransformerConfig(vocab_size=256, d_model=64, n_heads=4,
                             n_layers=2, d_ff=128, max_seq_len=64)
