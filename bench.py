@@ -204,6 +204,7 @@ def _control_bench(tensors: int = 64, ranks: int = 4,
     the memoized-plan replay — exactly what each tick costs on the
     production code path.
     """
+    from horovod_tpu import trace as _hvd_trace
     from horovod_tpu.ops import cache as hvd_cache
     from horovod_tpu.ops import wire
     from horovod_tpu.ops.coordinator import Coordinator
@@ -223,6 +224,11 @@ def _control_bench(tensors: int = 64, ranks: int = 4,
               for t in range(tensors)]
 
     def drain(coord, cache) -> int:
+        # Mirrors collective._drain's per-tick hvd-trace work (cycle
+        # advance + negotiate span + the 16-byte context trailer) so
+        # the trace on/off A/B below prices the span layer on the same
+        # path production ticks pay it.
+        t0 = time.monotonic() if _hvd_trace.enabled() else 0.0
         resps = []
         if cache is not None:
             marker = cache.take_flush_marker()
@@ -234,6 +240,12 @@ def _control_bench(tensors: int = 64, ranks: int = 4,
         if cache is not None:
             for resp in resps:
                 cache.observe_response(resp)
+        if resps and _hvd_trace.enabled():
+            _hvd_trace.next_cycle()
+            _hvd_trace.span("negotiate.tick", "negotiate", t0,
+                            time.monotonic(),
+                            args={"responses": len(resps)})
+            _hvd_trace.pack_ctx()
         return sum(len(r.tensor_names) for r in resps
                    if r.response_type == wire.ResponseType.ALLREDUCE)
 
@@ -312,6 +324,19 @@ def _control_bench(tensors: int = 64, ranks: int = 4,
         return round((1.0 - with_tel / without_tel) * 100.0, 2)
 
     tel_pct = overhead_pct(on_rate, notel_on_rate)
+
+    # hvd-trace overhead A/B (same contract as telemetry's): the same
+    # steady-state measurement with span recording disabled.  The
+    # baseline legs above ran with tracing at its default (on), so
+    # trace-off minus trace-on is the span layer's whole cost.
+    trace_was = _hvd_trace.enabled()
+    _hvd_trace.set_enabled(False)
+    try:
+        notrace_on_rate, _ = measure(cache_on=True)
+    finally:
+        _hvd_trace.set_enabled(trace_was)
+    trace_pct = overhead_pct(on_rate, notrace_on_rate)
+
     tel_counters = {
         name: m.get("value")
         for name, m in _telemetry.metrics().items()
@@ -337,6 +362,12 @@ def _control_bench(tensors: int = 64, ranks: int = 4,
             "overhead_off_pct": overhead_pct(off_rate, notel_off_rate),
             "overhead_ok": tel_pct is not None and tel_pct <= 5.0,
             "counters": tel_counters,
+        },
+        "trace": {
+            "trace_on": round(on_rate, 1),
+            "trace_off": round(notrace_on_rate, 1),
+            "overhead_pct": trace_pct,
+            "overhead_ok": trace_pct is not None and trace_pct <= 5.0,
         },
     }
 
@@ -522,6 +553,21 @@ def _dataplane_bench(tensors: int = 32, elems: int = 256,
             mk.set_enabled(None)
         tel_pct = (round((mega_lat / mega_lat_notel - 1.0) * 100.0, 2)
                    if mega_lat_notel else None)
+
+        # hvd-trace overhead A/B on the same leg: the launch + dispatch
+        # spans are per fused response, so the expected delta is
+        # noise-level too (the ≤ 5 % gate of docs/tracing.md).
+        from horovod_tpu import trace as _hvd_trace
+
+        trace_was = _hvd_trace.enabled()
+        _hvd_trace.set_enabled(False)
+        try:
+            _, _, mega_lat_notrace, _ = measure("notrace", True)
+        finally:
+            _hvd_trace.set_enabled(trace_was)
+            mk.set_enabled(None)
+        trace_pct = (round((mega_lat / mega_lat_notrace - 1.0) * 100.0,
+                           2) if mega_lat_notrace else None)
         snap = _telemetry.metrics()
         tel_counters = {
             name: m.get("value") for name, m in snap.items()
@@ -558,6 +604,14 @@ def _dataplane_bench(tensors: int = 32, elems: int = 256,
                 "overhead_pct": tel_pct,
                 "overhead_ok": tel_pct is not None and tel_pct <= 5.0,
                 "counters": tel_counters,
+            },
+            "trace": {
+                "megakernel_us_trace_on": round(mega_lat * 1e6, 1),
+                "megakernel_us_trace_off": round(
+                    mega_lat_notrace * 1e6, 1),
+                "overhead_pct": trace_pct,
+                "overhead_ok": trace_pct is not None
+                and trace_pct <= 5.0,
             },
         }
     finally:

@@ -112,5 +112,8 @@ from .analysis.program import verify_program  # noqa: F401
 from . import telemetry  # noqa: F401  (hvd.telemetry.flight & registry)
 from .telemetry import cluster_metrics, metrics  # noqa: F401
 from . import serving  # noqa: F401  (hvd.serving.InferenceEngine & co)
+from . import trace  # noqa: F401  (hvd.trace spans & clock alignment)
+from .trace.merge import dump_fleet_trace  # noqa: F401
+from .trace.watch import StragglerWatch  # noqa: F401
 
 __version__ = "0.1.0"

@@ -314,6 +314,13 @@ def init(devices=None) -> None:
         else:
             _state.autotuner = None
 
+        # hvd-trace: fresh span buffer + (step, cycle, trace_id)
+        # context for this incarnation; rank 0 mints the run's trace
+        # id, workers adopt it from the first response broadcast.
+        from .. import trace as _trace_mod
+
+        _trace_mod.reset_run(rank=_state.process_index)
+
         # hvd-telemetry: register the pull-side collector over the
         # runtime's stats structs (idempotent across re-inits) and, when
         # HVD_TPU_METRICS_PORT is set, serve /metrics + /healthz — rank

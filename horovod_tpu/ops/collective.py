@@ -50,6 +50,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..analysis import lockorder as _lockorder
 from ..analysis import program as _program
 from .. import chaos as _chaos
@@ -1233,6 +1234,10 @@ class _QueuedOp:
     # perf_counter at enqueue: the telemetry negotiate-latency stamp
     # (the one clock read this op spends before execution).
     t_submit: float = 0.0
+    # monotonic at enqueue (hvd-trace): start of the negotiate.wait
+    # span.  Separate stamp because spans must live on the clock the
+    # offset estimator aligns; 0.0 = tracing disabled at enqueue.
+    t_submit_mono: float = 0.0
 
 
 class _OpQueue:
@@ -1382,32 +1387,60 @@ def _execute_response(resp: Response, ops: List[_QueuedOp]) -> None:
     ERROR and dead-peer SHUTDOWN responses additionally dump the flight
     ring — the forensic record of the 2000 control-plane events that
     led here."""
-    if not _telemetry.enabled():
+    tracing = _trace.enabled()
+    if not _telemetry.enabled() and not tracing:
         return _execute_response_inner(resp, ops)
     t0 = time.perf_counter()
+    mt0 = time.monotonic() if tracing else 0.0
     is_data = resp.response_type in _DATA_RESPONSES
-    for o in ops:
-        if o.t_submit:
-            _M_NEGOTIATE_S.observe(t0 - o.t_submit)
-        _M_PAYLOAD_B.observe(o.nbytes)
-    if is_data:
-        _M_GROUP_WIDTH.observe(len(resp.tensor_names))
-    elif resp.response_type == ResponseType.ERROR:
-        _M_ERRORS.inc(max(len(ops), 1))
-        _telemetry.error_event(resp.error_message or "")
-    elif resp.response_type == ResponseType.SHUTDOWN and \
-            wire.DEAD_PEER_MARKER in (resp.error_message or ""):
-        # Worker-side dead-peer poison (the controller side dumps in
-        # _handle_lost_ranks before broadcasting this diagnosis).
-        _telemetry.dead_peer_event(resp.error_message or "")
+    if _telemetry.enabled():
+        for o in ops:
+            if o.t_submit:
+                _M_NEGOTIATE_S.observe(t0 - o.t_submit)
+            _M_PAYLOAD_B.observe(o.nbytes)
+        if is_data:
+            _M_GROUP_WIDTH.observe(len(resp.tensor_names))
+        elif resp.response_type == ResponseType.ERROR:
+            _M_ERRORS.inc(max(len(ops), 1))
+            _telemetry.error_event(resp.error_message or "")
+        elif resp.response_type == ResponseType.SHUTDOWN and \
+                wire.DEAD_PEER_MARKER in (resp.error_message or ""):
+            # Worker-side dead-peer poison (the controller side dumps in
+            # _handle_lost_ranks before broadcasting this diagnosis).
+            _telemetry.dead_peer_event(resp.error_message or "")
     out = _execute_response_inner(resp, ops)
     # Counted AFTER a successful data launch only: an ERROR/SHUTDOWN
     # response (or an exception from the executor) must not inflate the
     # success counter — "failed = submitted - completed" has to read
     # true during a failure storm.
-    if ops and is_data:
+    if ops and is_data and _telemetry.enabled():
         _M_COMPLETED.inc(len(ops))
         _M_EXECUTE_S.observe(time.perf_counter() - t0)
+    if ops and tracing and (is_data
+                            or resp.response_type == ResponseType.ERROR):
+        # hvd-trace: (1) the negotiate.wait span — this rank's local
+        # submit up to execution.  Every participating rank's wait span
+        # for one collective CONTAINS the shared window [last submit,
+        # broadcast], so same-(step, cycle) spans are guaranteed to
+        # overlap across ranks once clocks are aligned — the fleet
+        # -trace acceptance property.  (2) the dispatch span — the
+        # response execution (pack + launch + unpack); the launch span
+        # it contains (ops/megakernel.launch) lets the analyzer carve
+        # it into pack / collective / dcn / unpack legs.  ERROR
+        # responses trace too (the error path is real work and the
+        # control-plane-only tests ride it); the completed counter
+        # above stays data-only.
+        t_neg = min((o.t_submit_mono for o in ops
+                     if o.t_submit_mono > 0.0), default=0.0)
+        if t_neg:
+            _trace.span("negotiate.wait", "negotiate", t_neg, mt0,
+                        args={"tensors": len(resp.tensor_names)})
+        _trace.span(
+            f"execute/{resp.response_type.name.lower()}",
+            "dispatch", mt0, time.monotonic(),
+            args={"tensors": len(resp.tensor_names),
+                  "first": resp.tensor_names[0]
+                  if resp.tensor_names else ""})
     return out
 
 
@@ -2147,9 +2180,19 @@ def _drain() -> None:
                 # worker, then execute locally in the same order
                 # (≙ MPI_Bcast of the response list, operations.cc:1290).
                 tp.flush_unrouted()  # set requests that beat registration
+                tp.maybe_ping()  # hvd-trace clock probes (trace/clock.py)
+                tick_t0 = time.monotonic() if _trace.enabled() else 0.0
                 resps, groups, epoch, compact, n_other, replay_ids = \
                     _coordinator_tick(st)
                 if resps:
+                    # Advance the fleet-wide cycle id BEFORE the
+                    # broadcast: the frame's trace trailer and every
+                    # rank's execution spans then share it.
+                    if _trace.enabled():
+                        _trace.next_cycle()
+                        _trace.span("negotiate.tick", "negotiate",
+                                    tick_t0, time.monotonic(),
+                                    args={"responses": len(resps)})
                     if compact and groups and n_other == 0:
                         # Pure cache replay: the steady-state frame —
                         # entry-index groups instead of full payloads.
@@ -2173,6 +2216,12 @@ def _drain() -> None:
                     resps = tp.poll_responses()
                     if resps is None:
                         break
+                    # Adopt the controller's cycle id (the batch's
+                    # trace trailer) before executing, so this rank's
+                    # spans land under the same fleet-wide cycle.
+                    ctx = tp.last_trace_ctx
+                    if ctx is not None and _trace.enabled():
+                        _trace.observe_ctx(*ctx)
                     for resp in resps:
                         ops = _queue.take(resp.tensor_names)
                         if cache is not None:
@@ -2182,8 +2231,15 @@ def _drain() -> None:
                                     if o.request is not None}})
                         _execute_response(resp, ops)
             return
+        tick_t0 = time.monotonic() if _trace.enabled() else 0.0
         resps, _groups, _epoch, _compact, _n, replay_ids = \
             _coordinator_tick(st)
+        if resps and _trace.enabled():
+            # Single-process cycles advance the same counter so the
+            # local trace analyzes identically to a fleet's.
+            _trace.next_cycle()
+            _trace.span("negotiate.tick", "negotiate", tick_t0,
+                        time.monotonic(), args={"responses": len(resps)})
         for resp in resps:
             ops = _queue.take(resp.tensor_names)
             if cache is not None:
@@ -2295,7 +2351,9 @@ def _enqueue(x, op: RequestType, name: Optional[str],
                     root_rank=root_rank, handle=handle, nbytes=nbytes,
                     ps=process_set,
                     t_submit=(time.perf_counter()
-                              if _telemetry.enabled() else 0.0))
+                              if _telemetry.enabled() else 0.0),
+                    t_submit_mono=(time.monotonic()
+                                   if _trace.enabled() else 0.0))
     _M_SUBMITTED.inc()
     _queue.put(qop)
     # The execute paths read split info from the NEGOTIATED response

@@ -90,6 +90,7 @@ from ..core import topology as _topology
 from ..core.state import REPLICA_AXIS
 from ..utils import xla_dispatch as _xla_dispatch
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from . import compression as _compression
 from .wire import ReduceOp
 
@@ -1021,8 +1022,12 @@ def warm_start(mesh, directory: Optional[str] = None) -> int:
     return warmed
 
 
-def wire_accounting(spec: GroupSpec) -> Tuple[int, int]:
-    """``(logical_bytes, wire_bytes)`` one launch of ``spec`` moves.
+def wire_accounting_legs(spec: GroupSpec) -> Tuple[int, int, int]:
+    """``(logical_bytes, wire_bytes, dcn_bytes)`` one launch of ``spec``
+    moves — ``dcn_bytes`` is the cross-slice share of ``wire_bytes``
+    (0 for flat launches); the hvd-trace launch span carries both so
+    the analyzer can split a hierarchical launch's time into its ICI
+    and DCN legs.
 
     The model counts payload traversals per leg — flat reductions make
     two (the scatter- and gather-phase of a bandwidth-optimal
@@ -1042,7 +1047,7 @@ def wire_accounting(spec: GroupSpec) -> Tuple[int, int]:
         return (count * fmt.bits + 7) // 8 + (-(-count // fmt.block)) * 2
 
     if spec.hier is None:
-        return 2 * T * item, 2 * fmt_bytes(T, spec.quant)
+        return 2 * T * item, 2 * fmt_bytes(T, spec.quant), 0
     h = spec.hier
     F = -(-T // h.topo.ici_size)
     cast = spec.quant if (spec.quant is not None
@@ -1058,7 +1063,15 @@ def wire_accounting(spec: GroupSpec) -> Tuple[int, int]:
     else:
         dcn_f = cast
     logical = (2 * T + F) * item
-    return logical, 2 * fmt_bytes(T, ici_f) + fmt_bytes(F, dcn_f)
+    dcn_b = fmt_bytes(F, dcn_f)
+    return logical, 2 * fmt_bytes(T, ici_f) + dcn_b, dcn_b
+
+
+def wire_accounting(spec: GroupSpec) -> Tuple[int, int]:
+    """``(logical_bytes, wire_bytes)`` — see
+    :func:`wire_accounting_legs`."""
+    logical, wire_b, _dcn = wire_accounting_legs(spec)
+    return logical, wire_b
 
 
 def launch(spec: GroupSpec, mesh, values: Sequence,
@@ -1074,7 +1087,8 @@ def launch(spec: GroupSpec, mesh, values: Sequence,
     beyond the per-tensor contributions."""
     fn, cold = executable(spec, mesh, digest_fn)
     mask = tuple(donate_mask) if donate_mask is not None else spec.donate
-    logical_b, wire_b = wire_accounting(spec)
+    logical_b, wire_b, dcn_b = wire_accounting_legs(spec)
+    trace_t0 = time.monotonic() if _trace.enabled() else 0.0
 
     def dispatch():
         # XLA compiles on the cold executable's FIRST dispatch; time
@@ -1118,4 +1132,13 @@ def launch(spec: GroupSpec, mesh, values: Sequence,
                 stats.quant_launches += 1
     if _telemetry.enabled():
         _M_WIRE_BYTES.observe(wire_b)
+    if _trace.enabled():
+        # hvd-trace launch span: the compiled collective itself.  The
+        # wire-byte legs let the analyzer split a hierarchical launch's
+        # time into its ICI ("collective") and DCN shares.
+        _trace.span(f"megakernel/{spec.op}", "collective", trace_t0,
+                    time.monotonic(),
+                    args={"groups": len(spec.shapes),
+                          "hier": spec.hier is not None,
+                          "wire_bytes": wire_b, "dcn_bytes": dcn_b})
     return outs

@@ -63,8 +63,10 @@ from typing import Dict, List, Optional, Tuple
 from . import wire
 from .. import chaos as _chaos
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..analysis import lockorder as _lockorder
 from ..telemetry import flight as _flight
+from ..trace import clock as _trace_clock
 from ..utils.retry import BackoffPolicy
 from .wire import DEAD_PEER_MARKER, Request, Response, ResponseType
 
@@ -125,6 +127,23 @@ FRAME_RESUME = 12         # controller→worker, answering RECONNECT:
                           # cache-less.  Followed by the raw replay of
                           # every controller→worker frame the worker
                           # missed, in original stream order
+FRAME_PING = 13           # hvd-trace clock probe, controller→worker:
+                          # <I seq><d t0> (rank 0's monotonic at send).
+                          # Rides the replay ring like every broadcast;
+                          # a ring-replayed stale ping yields a
+                          # huge-RTT pong the min-RTT filter discards
+FRAME_PONG = 14           # worker→controller: <i rank><I seq><d t0>
+                          # <d t1> — t0 echoed, t1 the worker's
+                          # monotonic at receipt; rank 0 stamps arrival
+                          # (t2) and folds the NTP sample into its
+                          # per-peer offset estimator (trace/clock.py)
+FRAME_TRACE = 15          # hvd-trace span pull (trace/merge.py):
+                          # controller→worker <I round> requests the
+                          # worker's span buffer; worker→controller
+                          # <i rank><I round> + utf-8 JSON answers.
+                          # Round-keyed like FRAME_METRICS so a
+                          # straggler buffer from a timed-out pull
+                          # never completes a later one
 
 _FRAME_NAMES = {
     FRAME_HELLO: "HELLO", FRAME_REQUEST: "REQUEST",
@@ -134,6 +153,7 @@ _FRAME_NAMES = {
     FRAME_REQUEST_BATCH: "REQUEST_BATCH",
     FRAME_RESPONSE_BATCH: "RESPONSE_BATCH", FRAME_METRICS: "METRICS",
     FRAME_RECONNECT: "RECONNECT", FRAME_RESUME: "RESUME",
+    FRAME_PING: "PING", FRAME_PONG: "PONG", FRAME_TRACE: "TRACE",
 }
 
 
@@ -528,6 +548,22 @@ class ControllerTransport:
         # guarded_by: _met_cond
         self._met_payloads: Dict[int, Dict[int, dict]] = {}
         self._met_round = 0  # guarded_by: _met_cond
+        # hvd-trace span pull rendezvous (FRAME_TRACE): round → rank →
+        # decoded span list, same round-keying discipline.
+        self._trc_cond = threading.Condition(self._lock)
+        # guarded_by: _trc_cond
+        self._trc_payloads: Dict[int, Dict[int, list]] = {}
+        self._trc_round = 0  # guarded_by: _trc_cond
+        # hvd-trace clock alignment: per-peer NTP offset estimators fed
+        # by FRAME_PONG on the receive threads, reset on session resume.
+        self.clock = _trace_clock.ClockSync()
+        self._ping_seq = 0
+        self._last_ping = 0.0
+        # Probe cadence parsed ONCE: maybe_ping runs every drain tick,
+        # and an env read + float() per tick is avoidable hot-path
+        # cost (tests repointing HVD_TPU_TRACE_PING construct a fresh
+        # transport anyway).
+        self._ping_interval = _trace.ping_interval()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("0.0.0.0", port))
@@ -810,6 +846,10 @@ class ControllerTransport:
                 sess.conn = conn
                 sess.grace_deadline = None
         _M_RECONNECTS_ACCEPTED.inc()
+        # hvd-trace: the peer's network path changed — its old clock
+        # samples measured a connection that no longer exists.  Fresh
+        # pings (the drain tick's maybe_ping) re-converge the estimate.
+        self.clock.reset(rank)
         _flight.record("reconnect_accepted", rank, their_rx,
                        len(suffix), verdict)
         print(f"[hvd-reconnect] controller: rank {rank} resumed "
@@ -913,6 +953,25 @@ class ControllerTransport:
                     if mrnd in self._met_payloads:
                         self._met_payloads[mrnd][mrank] = snap
                         self._met_cond.notify_all()
+            elif ftype == FRAME_PONG:
+                # Clock sample: stamp the arrival FIRST (t2), before
+                # any parsing cost lands in the RTT.
+                t2 = time.monotonic()
+                prank, _seq, t0, t1 = struct.unpack_from("<iIdd",
+                                                         payload)
+                self.clock.on_pong(prank, t0, t1, t2)
+            elif ftype == FRAME_TRACE:
+                trank, trnd = struct.unpack_from("<iI", payload)
+                try:
+                    evs = json.loads(payload[8:].decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    evs = []
+                with self._trc_cond:
+                    # Same live-waiter discipline as FRAME_METRICS.
+                    if trnd in self._trc_payloads:
+                        self._trc_payloads[trnd][trank] = \
+                            evs if isinstance(evs, list) else []
+                        self._trc_cond.notify_all()
             elif ftype == FRAME_WITHDRAW:
                 (wrank,) = struct.unpack_from("<i", payload)
                 (nlen,) = struct.unpack_from("<H", payload, 4)
@@ -962,6 +1021,12 @@ class ControllerTransport:
             if not self._try_submit(req):
                 with self._lock:
                     self._unrouted.append((time.monotonic() + 5.0, req))
+        # hvd-trace trailer: the worker's (step, cycle) context — the
+        # controller's per-rank arrival stamp for this cycle, feeding
+        # the live skew tracker and the analyzer's straggler signal.
+        ctx = _trace.unpack_ctx(payload, off)
+        if ctx is not None:
+            _trace.note_batch_arrival(srank, ctx[0], ctx[1])
 
     def _route_coord(self, psid: int):
         """Coordinator for a process-set id (0 = global); None when the
@@ -1081,10 +1146,92 @@ class ControllerTransport:
                 # from under its wait loop.
                 self._met_payloads.pop(rnd, None)
 
+    # -- hvd-trace clock probes + span pull (trace/merge.py) ---------------
+    def ping_peers(self) -> None:
+        """One clock-probe broadcast: every worker answers FRAME_PONG
+        with its receive stamp; the receive threads fold the samples
+        into :attr:`clock`."""
+        self._ping_seq += 1
+        self._broadcast_frame(FRAME_PING, struct.pack(
+            "<Id", self._ping_seq, time.monotonic()))
+
+    def maybe_ping(self) -> None:
+        """Drain-tick hook: keep the per-peer offset estimates (and the
+        ``trace.clock_offset_seconds.*`` gauges) fresh at the
+        HVD_TPU_TRACE_PING cadence (parsed once at construction).  One
+        no-op float compare per tick when not due; silent when tracing
+        is disabled."""
+        if not _trace.enabled() or self._ping_interval <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_ping >= self._ping_interval:
+            self._last_ping = now
+            self.ping_peers()
+
+    def measure_clock_offsets(self, probes: int = 8,
+                              timeout: float = 2.0) -> Dict[int, float]:
+        """Probe burst ahead of a fleet-trace merge: send ``probes``
+        pings and wait until every connected peer contributed at least
+        one NEW sample (or the timeout lapses — a dead peer must not
+        stall the dump).  Returns the refreshed offsets."""
+        with self._lock:
+            live = [s.rank for s in self._sess.values()
+                    if s.conn is not None]
+        before = self.clock.sample_counts()
+        deadline = time.monotonic() + timeout
+        for i in range(max(1, probes)):
+            self.ping_peers()
+            time.sleep(min(0.005, timeout / max(1, probes)))
+        while time.monotonic() < deadline:
+            counts = self.clock.sample_counts()
+            if all(counts.get(r, 0) > before.get(r, 0) for r in live):
+                break
+            time.sleep(0.005)
+        return self.clock.offsets()
+
+    def collect_traces(self, own: list,
+                       timeout: float = 10.0) -> Dict[int, list]:
+        """Pull every rank's span buffer (FRAME_TRACE) — the
+        ``collect_metrics`` rendezvous, round-keyed so a straggler
+        buffer from an abandoned pull never completes a later one.  A
+        rank that died or timed out is simply absent."""
+        deadline = time.monotonic() + timeout
+        with self._trc_cond:
+            self._trc_round += 1
+            rnd = self._trc_round
+            this_round = self._trc_payloads.setdefault(rnd, {})
+            this_round[0] = list(own)
+        self._broadcast_frame(FRAME_TRACE, struct.pack("<I", rnd))
+        with self._trc_cond:
+            try:
+                while len(this_round) < self.num_processes:
+                    remaining = deadline - time.monotonic()
+                    missing = set(range(self.num_processes)) \
+                        - set(this_round)
+                    if remaining <= 0 or (self.lost_ranks
+                                          and missing <=
+                                          set(self.lost_ranks)):
+                        break
+                    self._trc_cond.wait(min(remaining, 0.1))
+                return dict(this_round)
+            finally:
+                # Drop ONLY this round (concurrent callers each own
+                # one — the collect_metrics discipline).
+                self._trc_payloads.pop(rnd, None)
+
     # -- controller-side API used by the drain loop ------------------------
     def submit(self, req: Request) -> bool:
         """Rank 0's own submit; returns True when the request was served
         from the response cache (the coordinator facade's fast path)."""
+        # hvd-trace arrival stamp: rank 0's traffic never crosses the
+        # wire, so its first submit of the cycle stands in for the
+        # request-batch arrival the workers' trailers produce — the
+        # skew baseline StragglerWatch measures the fleet against.
+        # note_batch_arrival dedups per (rank, step, cycle), so the
+        # per-tensor calls after the first are one tracker lookup.
+        if _trace.enabled():
+            step, cycle, _tid = _trace.current_ctx()
+            _trace.note_batch_arrival(0, step, cycle)
         coord = self._route_coord(req.process_set_id)
         if coord is None:
             raise RuntimeError(
@@ -1127,8 +1274,14 @@ class ControllerTransport:
     def broadcast_responses(self, responses: List[Response]) -> None:
         _flight.record("bcast_responses", len(responses),
                        ",".join(r.response_type.name for r in responses))
+        # hvd-trace trailer: rank 0's (step, cycle, trace_id) rides
+        # every response broadcast so all ranks tag the cycle's
+        # execution spans with the SAME fleet-wide cycle id.  The
+        # packed list is self-delimiting; pre-trace parsers never read
+        # the 16 extra bytes.
         self._broadcast_frame(FRAME_RESPONSES,
-                              wire.pack_response_list(responses))
+                              wire.pack_response_list(responses)
+                              + _trace.pack_ctx())
 
     def broadcast_replay(self, groups: List[List[int]],
                          epoch: int) -> None:
@@ -1141,7 +1294,8 @@ class ControllerTransport:
         for g in groups:
             payload += struct.pack("<H", len(g))
             payload += struct.pack(f"<{len(g)}I", *g)
-        self._broadcast_frame(FRAME_RESPONSE_BATCH, payload)
+        self._broadcast_frame(FRAME_RESPONSE_BATCH,
+                              payload + _trace.pack_ctx())
 
     def poll_responses(self):
         return None  # responses come from the coordinator on rank 0
@@ -1179,7 +1333,13 @@ class WorkerTransport:
         # single FRAME_REQUEST_BATCH by flush_requests: ("bit", epoch,
         # entry_idx) response-cache hits and ("req", packed) fulls.
         self._pending: List[tuple] = []  # guarded_by: _buf_lock
-        self._responses: "queue.Queue[List[Response]]" = queue.Queue()
+        # Queued (responses, trace_ctx) batches: the hvd-trace context
+        # trailer travels WITH its batch so the drain tick adopts the
+        # right cycle id even when several broadcasts queue up.
+        self._responses: "queue.Queue[tuple]" = queue.Queue()
+        # The last popped batch's trace context (step, cycle, trace_id)
+        # or None; read by the drain loop right after poll_responses.
+        self.last_trace_ctx: Optional[tuple] = None
         # verify_program verdicts (FRAME_SIGRESULT) as (round, verdict);
         # the round counter lets exchange_signature discard a stale
         # verdict left queued by a timed-out earlier round.
@@ -1313,11 +1473,11 @@ class WorkerTransport:
         disarm_distributed_shutdown()
         _telemetry.dead_peer_event(
             f"rank {self.rank}: controller unreachable ({detail})")
-        self._responses.put([Response(
+        self._responses.put(([Response(
             ResponseType.SHUTDOWN,
             error_message="Horovod has been shut down: the rank-0 "
             f"controller {DEAD_PEER_MARKER} while collectives were "
-            f"pending ({detail}).")])
+            f"pending ({detail}).")], None))
 
     def _recv_loop_inner(self) -> None:
         while True:
@@ -1354,6 +1514,7 @@ class WorkerTransport:
                     groups.append(list(struct.unpack_from(
                         f"<{n}I", payload, off)))
                     off += 4 * n
+                ctx = _trace.unpack_ctx(payload, off)
                 try:
                     if self.cache is None:
                         raise RuntimeError(
@@ -1366,13 +1527,13 @@ class WorkerTransport:
                     # loudly instead of executing desynced responses.
                     print(f"ERROR: rank {self.rank}: {e}",
                           file=sys.stderr)
-                    self._responses.put([Response(
+                    self._responses.put(([Response(
                         ResponseType.SHUTDOWN,
                         error_message="Horovod has been shut down: "
                         f"response-cache replica desync on rank "
-                        f"{self.rank}: {e}")])
+                        f"{self.rank}: {e}")], None))
                     continue
-                self._responses.put(resps)
+                self._responses.put((resps, ctx))
                 continue
             if ftype == FRAME_SIGRESULT:
                 (rnd,) = struct.unpack_from("<I", payload)
@@ -1394,15 +1555,38 @@ class WorkerTransport:
                 self._send(FRAME_METRICS,
                            struct.pack("<iI", self.rank, rnd) + body)
                 continue
+            if ftype == FRAME_PING:
+                # hvd-trace clock probe: stamp the receipt FIRST so
+                # parsing cost never lands in the offset, then answer
+                # immediately from this thread — any queueing would
+                # inflate the RTT (the filter would only discard it).
+                t1 = time.monotonic()
+                seq, t0 = struct.unpack_from("<Id", payload)
+                self._send(FRAME_PONG, struct.pack(
+                    "<iIdd", self.rank, seq, t0, t1))
+                continue
+            if ftype == FRAME_TRACE:
+                # hvd-trace span pull: answer with this rank's buffer,
+                # echoing the round (the FRAME_METRICS discipline).
+                (rnd,) = struct.unpack_from("<I", payload)
+                try:
+                    body = json.dumps(
+                        _trace.export_events()).encode("utf-8")
+                except Exception:  # noqa: BLE001 — must answer anyway
+                    body = b"[]"
+                self._send(FRAME_TRACE,
+                           struct.pack("<iI", self.rank, rnd) + body)
+                continue
             if ftype == FRAME_RESPONSES:
-                resps = wire.unpack_response_list(payload)
+                resps, off = wire.unpack_response_list_ex(payload)
+                ctx = _trace.unpack_ctx(payload, off)
                 # Controller-initiated shutdown arrives as a SHUTDOWN-type
                 # Response inside the list (the one spelling of the
                 # protocol); note it for observability.
                 if any(r.response_type == ResponseType.SHUTDOWN
                        for r in resps):
                     self.shutdown_received.set()
-                self._responses.put(resps)
+                self._responses.put((resps, ctx))
 
     # -- session resume (hvd-chaos reconnect protocol) ---------------------
     def _drop_cache_replica(self) -> None:
@@ -1580,13 +1764,16 @@ class WorkerTransport:
                 for b in idxs:
                     arr[b // 8] |= 1 << (b % 8)
                 bitvec = bytes(arr)
-            # The full requests ride the last epoch's frame.
+            # The full requests ride the last epoch's frame; the
+            # hvd-trace trailer (this rank's step/cycle context) rides
+            # every one — the controller's arrival stamp per cycle.
             tail = b"".join(reqs) if i == len(epochs) - 1 else b""
             nreq = len(reqs) if i == len(epochs) - 1 else 0
             self._send(
                 FRAME_REQUEST_BATCH,
                 struct.pack("<iII", self.rank, epoch, len(bitvec))
-                + bitvec + struct.pack("<H", nreq) + tail)
+                + bitvec + struct.pack("<H", nreq) + tail
+                + _trace.pack_ctx())
 
     def request_shutdown(self) -> None:
         self.flush_requests()  # preserve request-before-shutdown order
@@ -1633,11 +1820,17 @@ class WorkerTransport:
                    + struct.pack("<H", process_set_id))
 
     def poll_responses(self) -> Optional[List[Response]]:
-        """Next broadcast response list, or None if nothing arrived."""
+        """Next broadcast response list, or None if nothing arrived.
+        The batch's hvd-trace context (when its frame carried one) is
+        left on :attr:`last_trace_ctx` for the drain loop to adopt
+        before executing — context and batch stay paired even when
+        several broadcasts queued up."""
         try:
-            return self._responses.get_nowait()
+            resps, ctx = self._responses.get_nowait()
         except queue.Empty:
             return None
+        self.last_trace_ctx = ctx
+        return resps
 
     def close(self) -> None:
         self._closing = True

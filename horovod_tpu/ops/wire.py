@@ -324,11 +324,19 @@ def pack_response_list(responses: List[Response]) -> bytes:
     return out
 
 
-def unpack_response_list(buf: bytes) -> List[Response]:
+def unpack_response_list_ex(buf: bytes) -> Tuple[List[Response], int]:
+    """Parse a packed response list and ALSO return the consumed byte
+    count — the list is self-delimiting, so callers can carry trailers
+    after it (the hvd-trace context on FRAME_RESPONSES) that old
+    parsers simply never read."""
     (n,) = struct.unpack_from("<H", buf, 0)
     off = 2
     out = []
     for _ in range(n):
         r, off = Response.unpack(buf, off)
         out.append(r)
-    return out
+    return out, off
+
+
+def unpack_response_list(buf: bytes) -> List[Response]:
+    return unpack_response_list_ex(buf)[0]

@@ -54,6 +54,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import chaos as _chaos
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
 from ..telemetry import flight as _flight
@@ -180,6 +181,7 @@ class PrefetchIterator:
             # blocked path only.  Timed gets so a close() from another
             # thread (which enqueues nothing) wakes this consumer too.
             t0 = time.perf_counter()
+            mt0 = time.monotonic() if _trace.enabled() else 0.0
             while True:
                 try:
                     item = self._q.get(timeout=0.05)
@@ -189,6 +191,12 @@ class PrefetchIterator:
                         _M_STALL.observe(time.perf_counter() - t0)
                         raise StopIteration from None
             _M_STALL.observe(time.perf_counter() - t0)
+            if _trace.enabled():
+                # hvd-trace host span: the analyzer's "this rank was
+                # input-bound" signal — the blame category a seeded
+                # slow loader must surface under (docs/tracing.md).
+                _trace.span("prefetch.wait", "host", mt0,
+                            time.monotonic())
         _M_DEPTH.set(self._q.qsize())
         if item is _END:
             self._stop.set()

@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..core import compat as _compat
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
@@ -175,6 +176,29 @@ class _ThrottledStep:
         return getattr(self._step_fn, name)
 
 
+class _TracedStep:
+    """hvd-trace step counter: advance the propagated step id once per
+    call (trace/__init__.py), so every span this step's collectives /
+    prefetch waits / checkpoint writes produce carries the step that
+    owns it — the key the fleet-trace analyzer groups by.  Arithmetic
+    is untouched; the jit surface passes through like
+    :class:`_ThrottledStep`'s."""
+
+    def __init__(self, step_fn):
+        self._step_fn = step_fn
+
+    def __call__(self, *args, **kw):
+        _trace.on_step()
+        return self._step_fn(*args, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._step_fn, name)
+
+
+def _traced(step_fn):
+    return _TracedStep(step_fn) if _trace.trace_enabled_env() else step_fn
+
+
 def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
                has_aux, donate, has_state, op=None, overlap=None):
     """Shared builder behind :func:`make_train_step` and
@@ -221,10 +245,10 @@ def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
             loss_fn, optimizer, mesh, red_op, fusion_threshold, has_aux,
             donate, has_state, compression, stream=schedule == "stream",
             fallback_builder=fallback_builder)
-        return _throttle_on_cpu(step, mesh)
-    return _build_static_step(loss_fn, optimizer, mesh, average,
-                              fusion_threshold, has_aux, donate,
-                              has_state, op, compression)
+        return _traced(_throttle_on_cpu(step, mesh))
+    return _traced(_build_static_step(loss_fn, optimizer, mesh, average,
+                                      fusion_threshold, has_aux, donate,
+                                      has_state, op, compression))
 
 
 def _build_static_step(loss_fn, optimizer, mesh, average, fusion_threshold,
@@ -384,8 +408,8 @@ def make_parallel_train_step(loss_fn: Callable[..., Any], optimizer,
         return params, opt_state, loss
 
     donate_argnums = (0, 1) if donate else ()
-    return _throttle_on_cpu(jax.jit(step, donate_argnums=donate_argnums),
-                            mesh)
+    return _traced(_throttle_on_cpu(
+        jax.jit(step, donate_argnums=donate_argnums), mesh))
 
 
 def shard_parallel_batch(batch, mesh, batch_spec):

@@ -48,6 +48,7 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..analysis import lockorder as _lockorder
 from ..core.topology import MODEL_AXIS
 from ..telemetry import flight as _flight
@@ -460,6 +461,19 @@ class InferenceEngine:
         if reason is not None:
             req.t_done = time.perf_counter()
             self.cache.free_slot(slot)  # idempotent vs the drain
+            if _trace.enabled():
+                # hvd-trace serving span: the whole request lifetime
+                # (submit -> completion), reconstructed from the wall
+                # stamps the engine already keeps — serving load on the
+                # shared mesh is visible next to training cycles in
+                # the fleet trace.
+                now = time.monotonic()
+                _trace.span(
+                    "serving.request", "serving",
+                    now - (req.t_done - req.t_submit), now,
+                    args={"rid": req.rid,
+                          "tokens": len(req.generated),
+                          "reason": reason})
         else:
             self._last_token[slot] = token
 

@@ -35,6 +35,33 @@ from .registry import (  # noqa: F401
 # handles at import time).
 _default = MetricsRegistry()
 
+# Metric-name prefixes worth carrying in a flight dump's compact tail:
+# the control-plane, data-plane and host counters that contextualize a
+# stall (docs/metrics.md "Dump format").
+_FLIGHT_TAIL_PREFIXES = ("collective.", "transport.", "host.",
+                        "events.", "input.", "trace.", "chaos.")
+
+
+def _flight_metrics_tail() -> Dict[str, object]:
+    """The compact snapshot appended to every flight dump (satellite of
+    hvd-trace): counters/gauges as bare values, histograms as
+    count+sum.  Collectors are skipped — they read runtime structures
+    and a dump may fire from under runtime locks; the striped leaves
+    below are lock-free."""
+    out: Dict[str, object] = {}
+    for name, m in _default.snapshot(run_collectors=False).items():
+        if not name.startswith(_FLIGHT_TAIL_PREFIXES):
+            continue
+        if m.get("type") == "histogram":
+            out[name] = {"count": m.get("count", 0),
+                         "sum": m.get("sum", 0)}
+        else:
+            out[name] = m.get("value", 0)
+    return out
+
+
+flight.set_metrics_provider(_flight_metrics_tail)
+
 
 def registry() -> MetricsRegistry:
     return _default

@@ -55,6 +55,31 @@ def flight_dir() -> Optional[str]:
     return os.environ.get("HVD_TPU_FLIGHT_DIR") or None
 
 
+# Compact metrics tail appended to every dump: a stall/dead-peer dump
+# then carries the collective/transport/host counters at dump time, so
+# the forensic record is self-contained — no separate hvd.metrics()
+# call to correlate by hand.  Injected (set_metrics_provider, from
+# telemetry/__init__.py) so this module stays stdlib-only.
+_metrics_provider = None
+
+
+def set_metrics_provider(fn) -> None:
+    """Install the callable whose dict becomes each dump's ``metrics``
+    tail (None clears it).  The provider must be cheap and lock-free —
+    dumps fire from failure paths that may hold runtime locks."""
+    global _metrics_provider
+    _metrics_provider = fn
+
+
+def _metrics_tail() -> Optional[dict]:
+    if _metrics_provider is None:
+        return None
+    try:
+        return _metrics_provider()
+    except Exception:  # noqa: BLE001 — the dump must not mask failures
+        return None
+
+
 def _rank_of() -> int:
     """Best-effort rank for dump filenames; resolved lazily so this
     module never imports runtime state at load time."""
@@ -147,6 +172,9 @@ class FlightRecorder:
                 "extra": extra or {},
                 "events": events,
             }
+            tail = _metrics_tail()
+            if tail is not None:
+                payload["metrics"] = tail
             os.makedirs(d, exist_ok=True)
             slug = _SAN_RE.sub("-", reason)[:48] or "event"
             path = os.path.join(

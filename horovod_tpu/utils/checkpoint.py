@@ -47,6 +47,7 @@ import numpy as np
 
 from .. import chaos as _chaos
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..analysis import lockorder as _lockorder
 from ..core import state as _state
 from ..parallel.data import broadcast_parameters
@@ -192,6 +193,7 @@ class _Writer:
                 continue
             handle, host_tree, step = item
             t0 = time.perf_counter()
+            mt0 = time.monotonic() if _trace.enabled() else 0.0
             try:
                 from flax import serialization
 
@@ -206,6 +208,13 @@ class _Writer:
                     handle.path, f"{type(e).__name__}: {e}")
             finally:
                 _M_WRITE_SECONDS.observe(time.perf_counter() - t0)
+                if _trace.enabled():
+                    # hvd-trace: a write that stole the cycle shows up
+                    # in the fleet trace as a checkpoint-leg span.
+                    _trace.span("checkpoint.write", "checkpoint", mt0,
+                                time.monotonic(),
+                                args={"path": os.path.basename(
+                                    handle.path)})
                 with self._lock:
                     self._pending -= 1
                     _M_PENDING.set(self._pending)

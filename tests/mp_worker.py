@@ -1147,6 +1147,106 @@ def scenario_metrics(hvd):
     print(f"METRICS_OK rank={rank}")
 
 
+def scenario_trace(hvd):
+    """hvd-trace acceptance (ISSUE 10): a seeded slow rank (rank 1
+    pays a loader stall before each collective — the slow-loader
+    scenario, instrumented exactly as the prefetch consumer
+    instruments its blocked wait) across REAL processes.  Rank 0 then
+    (a) merges the fleet trace — both ranks present, same-(step,
+    cycle) negotiate spans OVERLAP after clock correction — and (b)
+    runs the analyzer, which must attribute the stall to rank 1 with
+    blame category ``host``.
+
+    Control-plane-only traffic (the scenario_metrics trick:
+    deliberately mismatched shapes negotiate fully, broadcast an ERROR
+    and execute it on every rank with zero data-plane work), so this
+    leg runs under any jax build."""
+    import json as _json
+    import time as _time
+
+    import jax.numpy as jnp
+
+    import horovod_tpu.trace as trace
+    from horovod_tpu import HorovodError
+
+    rank = hvd.rank()
+    out = os.environ.get("HVD_TPU_TRACE_OUT",
+                         "/tmp/hvd_fleet_trace.json")
+    for step in range(1, 4):
+        trace.set_step(step)
+        if rank == 1:
+            # The slow loader: a real stall on this rank's step path,
+            # recorded as the host-leg span prefetch_to_device records
+            # for its blocked consumer.
+            t0 = _time.monotonic()
+            _time.sleep(0.15)
+            trace.span("prefetch.wait", "host", t0, _time.monotonic())
+        try:
+            hvd.allreduce(jnp.zeros((2 + rank,), jnp.float32),
+                          name=f"tr.{step}", average=False)
+            raise AssertionError("mismatched tr did not raise")
+        except HorovodError as e:
+            assert "Mismatched allreduce tensor shapes" in str(e), \
+                str(e)
+    _time.sleep(0.3)  # let the last broadcast's spans land everywhere
+
+    if rank == 0:
+        path = hvd.dump_fleet_trace(out, timeout=30.0)
+        data = _json.load(open(path))
+        evs = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+        pids = {e["pid"] for e in evs}
+        assert {0, 1} <= pids, pids
+        # Clock alignment ran: a measured offset for the worker.
+        assert "1" in data["metadata"]["clock_offsets_seconds"], \
+            data["metadata"]
+        # Same-(step, cycle) negotiate spans from BOTH ranks overlap
+        # after clock correction — every rank's submit->execute window
+        # contains the shared [last submit, broadcast] interval.
+        windows = {}
+        for e in evs:
+            if e["cat"] != "negotiate":
+                continue
+            k = (e["args"]["step"], e["args"]["cycle"])
+            lo, hi = e["ts"], e["ts"] + e["dur"]
+            cur = windows.setdefault(k, {}).get(e["pid"])
+            windows[k][e["pid"]] = (
+                (lo, hi) if cur is None
+                else (min(cur[0], lo), max(cur[1], hi)))
+        shared = [k for k, d in windows.items() if {0, 1} <= set(d)]
+        assert shared, windows
+        overlaps = [k for k in shared
+                    if windows[k][0][0] < windows[k][1][1]
+                    and windows[k][1][0] < windows[k][0][1]]
+        assert overlaps, (shared, windows)
+        # The analyzer names the seeded slow rank with blame "host".
+        from horovod_tpu.trace.analyze import analyze
+
+        report = analyze(data["traceEvents"])
+        host_blamed = [c for c in report["cycles"]
+                       if c["straggler"] == 1 and c["blame"] == "host"]
+        assert len(host_blamed) >= 3, report["cycles"]
+        # Determinism (the CI trace-analysis gate): two replays of the
+        # same merged file are byte-identical.
+        a = _json.dumps(analyze(data["traceEvents"]), sort_keys=True)
+        b = _json.dumps(analyze(data["traceEvents"]), sort_keys=True)
+        assert a == b
+    else:
+        try:
+            hvd.dump_fleet_trace(out)
+            raise AssertionError("dump_fleet_trace must be rank-0-only")
+        except RuntimeError as e:
+            assert "rank-0" in str(e), str(e)
+    # Barrier via a full-negotiation mismatch: keeps rank 1 alive (and
+    # answering the FRAME_TRACE pull) until rank 0's merge finished.
+    try:
+        hvd.allreduce(jnp.zeros((2 + rank,), jnp.float32),
+                      name="tr.done", average=False)
+        raise AssertionError("mismatched tr.done did not raise")
+    except HorovodError:
+        pass
+    print(f"TRACE_OK rank={rank}")
+
+
 def scenario_combo(hvd):
     """Run several NON-DESTRUCTIVE scenarios sequentially in ONE launch
     (``HVD_TPU_COMBO`` names them, comma-separated).  Every separate

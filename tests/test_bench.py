@@ -63,6 +63,11 @@ def test_bench_control_mode_contract_and_speedup():
     assert tel["cache_on_metrics_off"] > 0
     assert "overhead_pct" in tel and "overhead_ok" in tel
     assert isinstance(tel["counters"], dict)
+    # hvd-trace overhead A/B rides the same JSON (ISSUE 10 gate, same
+    # quiet-box caveat for the ok-boolean).
+    tr = payload["trace"]
+    assert tr["trace_on"] > 0 and tr["trace_off"] > 0
+    assert "overhead_pct" in tr and "overhead_ok" in tr
 
 
 def test_bench_dataplane_mode_contract_and_gates():
@@ -99,6 +104,10 @@ def test_bench_dataplane_mode_contract_and_gates():
     assert tel["megakernel_us_metrics_off"] > 0
     assert "overhead_pct" in tel
     assert tel["counters"].get("megakernel.launches", 0) >= 1, tel
+    # hvd-trace overhead A/B on the same leg (ISSUE 10).
+    tr = payload["trace"]
+    assert tr["megakernel_us_trace_off"] > 0
+    assert "overhead_pct" in tr and "overhead_ok" in tr
     # Bytes-on-wire accounting (ISSUE 6): per-compressor legs with
     # logical vs wire bytes per cycle, the compression ratio, the
     # eager-reference equality verdict, and the dispatch count proving
