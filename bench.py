@@ -372,6 +372,96 @@ def _control_bench(tensors: int = 64, ranks: int = 4,
     }
 
 
+def _tree_bench(tensors: int = 16, seconds: float = 0.4) -> dict:
+    """Tree-overlay section of ``--mode control``: rank-0 received
+    control frames per steady-state negotiation cycle (and per
+    metrics/trace pull) at simulated world sizes 64/256/1024, plus the
+    root's merged-envelope processing rate.
+
+    Virtual-slice-style dryrun, no XLA and no sockets: the layouts and
+    per-child envelopes come from the REAL aggregation code
+    (ops/tree.steady_envelope — the same grouping the live interiors
+    run), and the root side runs the REAL ResponseCache accounting +
+    fused replay per envelope section.  The frame counts are the
+    structural quantity the CI gate bounds: rank 0 receives one merged
+    envelope per direct child instead of world-1 per-rank frames."""
+    import math
+
+    from horovod_tpu.ops import cache as hvd_cache
+    from horovod_tpu.ops import tree as hvd_tree
+    from horovod_tpu.ops import wire
+
+    # Pinned, not read from HVD_TPU_TREE_FANOUT: the gate's bound and
+    # the contract test's flat-vs-tree ratio assume this shape, and an
+    # ambient env setting must not fail the bench without a code
+    # defect (tests/test_tree.py pins the same way).
+    fanout = 8
+    threshold = 64 << 20
+
+    def request_of(t: int, r: int) -> "wire.Request":
+        return wire.Request(
+            request_rank=r, request_type=wire.RequestType.ALLREDUCE,
+            tensor_type=wire.DataType.FLOAT32, tensor_name=f"grad.{t}",
+            tensor_shape=(1024,), reduce_op=wire.ReduceOp.SUM)
+
+    worlds = []
+    for world in (64, 256, 1024):
+        layout = hvd_tree.build_layout(world, fanout)
+        cache = hvd_cache.ResponseCache(rank=0)
+        for t in range(tensors):
+            name = f"grad.{t}"
+            cache.stage_negotiated(
+                name, {r: request_of(t, r) for r in range(world)})
+            cache.observe_response(wire.Response(
+                wire.ResponseType.ALLREDUCE, tensor_names=[name],
+                tensor_shapes=[(1024,)],
+                tensor_type=wire.DataType.FLOAT32))
+        epoch = cache.epoch
+        idxs = list(range(tensors))
+        envelopes = [hvd_tree.steady_envelope(layout, c, epoch, idxs)
+                     for c in layout.children(0)]
+
+        def one_cycle() -> int:
+            for i in idxs:  # rank 0's own hits
+                cache.hit_from_wire(i, 0, epoch)
+            for env in envelopes:
+                for sec in hvd_tree.iter_subtree_sections(env):
+                    if sec[0] == "bits":
+                        _k, ep, ranks, ii = sec
+                        for r in ranks:
+                            for i in ii:
+                                cache.hit_from_wire(i, r, ep)
+            resps, _g, _e, _c = cache.take_ready(lambda _p: threshold)
+            for r in resps:
+                cache.observe_response(r, replay=True)
+            return sum(len(r.tensor_names) for r in resps)
+
+        got = one_cycle()
+        assert got == tensors, (got, tensors)
+        done = 0
+        cycles = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            done += one_cycle()
+            cycles += 1
+        dt = time.perf_counter() - t0
+        # Structural frame accounting comes from the one shared
+        # implementation (ops/tree.simulate_cycle_frames) — the bench
+        # adds only the measured processing rate and the gate bound.
+        stats = hvd_tree.simulate_cycle_frames(world, fanout)
+        stats["fanout_log_bound"] = fanout * max(1, math.ceil(
+            math.log(world, max(2, fanout))))
+        stats["negotiations_per_sec"] = round(done / dt, 1)
+        stats["cycles"] = cycles
+        worlds.append(stats)
+    return {
+        "metric": "tree_root_frames_per_cycle",
+        "fanout": fanout,
+        "tensors": tensors,
+        "worlds": worlds,
+    }
+
+
 def _dataplane_bench(tensors: int = 32, elems: int = 256,
                      cycles: int = 30) -> dict:
     """Steady-state fused-cycle latency + dispatches/cycle, eager
@@ -1227,6 +1317,11 @@ def main() -> int:
                          "throughput floor vs the adjacent uncompressed "
                          "leg (parity on a quiet box; the floor keeps "
                          "the CI gate load-proof)")
+    ap.add_argument("--check-tree-frames", type=float, default=None,
+                    help="with --mode control: fail unless rank-0 rx "
+                         "frames per simulated cycle stay under "
+                         "C*fanout*log_fanout(world) at every "
+                         "simulated world size (ops/tree.py gate)")
     ap.add_argument("--control-seconds", type=float, default=1.0,
                     help="control mode: seconds per measurement leg")
     ap.add_argument("--batch-size", type=int, default=128)
@@ -1258,6 +1353,7 @@ def main() -> int:
 
     if args.mode == "control":
         result = _control_bench(seconds=args.control_seconds)
+        result["tree"] = _tree_bench()
         print(json.dumps(result))
         if args.check_speedup is not None:
             speedup = result.get("speedup") or 0.0
@@ -1265,6 +1361,29 @@ def main() -> int:
                 print(f"FAIL: response-cache speedup {speedup}x is below "
                       f"the required {args.check_speedup}x",
                       file=sys.stderr)
+                return 1
+        if args.check_tree_frames is not None:
+            # The scale-out gate (CI job tree-bench): at simulated
+            # world=256 rank 0's per-cycle frame count must sit under
+            # c * fanout * log_fanout(world) — i.e. the tree actually
+            # deleted the O(world) frame funnel, structurally.
+            failures = []
+            for w in result["tree"]["worlds"]:
+                bound = args.check_tree_frames * w["fanout_log_bound"]
+                if w["tree_frames_per_cycle"] > bound:
+                    failures.append(
+                        f"world={w['world']}: "
+                        f"{w['tree_frames_per_cycle']} rank-0 frames "
+                        f"per cycle > allowed {bound:.0f}")
+                if w["world"] >= 64 and w["tree_frames_per_cycle"] * 4 \
+                        > w["flat_frames_per_cycle"]:
+                    failures.append(
+                        f"world={w['world']}: tree frames "
+                        f"{w['tree_frames_per_cycle']} not ≤ 1/4 of "
+                        f"flat {w['flat_frames_per_cycle']}")
+            if failures:
+                for f in failures:
+                    print(f"FAIL: {f}", file=sys.stderr)
                 return 1
         return 0
 

@@ -51,7 +51,7 @@ def main(argv=None) -> int:
         if args.node == 0:
             _matrix.run_cp_controller(args.np, args.port)
         else:
-            _matrix.run_cp_worker(args.node, args.port)
+            _matrix.run_cp_worker(args.node, args.port, args.np)
         return 0
     if args.scenario:
         fn = _matrix.LOCAL_SCENARIOS.get(args.scenario)

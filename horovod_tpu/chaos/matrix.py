@@ -118,6 +118,30 @@ SCENARIOS: List[Scenario] = [
              "HVD_TPU_RECONNECT_GRACE": "1.5"},
         doc="worker dies hard (no reconnect ever comes): the grace "
             "window expires into a diagnostic naming the fault"),
+    # -- tree overlay (ops/tree.py): interior-node death + re-parent -----
+    Scenario(
+        "tree_interior_down", "cp", "recover", np=3, cap=150.0,
+        spec="tree.relay_reset:count=1:after=40:rank=1;"
+             "transport.reset:count=1:after=30:rank=1@31",
+        needle="re-parent",
+        env={"HVD_TPU_TREE": "on", "HVD_TPU_TREE_FANOUT": "1",
+             "HVD_TPU_RECONNECT_GRACE": "15",
+             "HVD_TPU_RECONNECT_DEADLINE": "15"},
+        doc="np=3 chain 0<-1<-2: BOTH of the interior's links die "
+            "(uplink reset + child-link relay reset); rank 1 resumes "
+            "its uplink, rank 2 re-parents to the root via the "
+            "session-resume listener; results (and the mid-run fleet "
+            "metrics pull) identical to the fault-free tree run"),
+    Scenario(
+        "tree_leaf_reset", "cp", "recover", np=3, cap=150.0,
+        spec="transport.reset:count=1:after=25:rank=2@32",
+        needle="session resumed",
+        env={"HVD_TPU_TREE": "on", "HVD_TPU_TREE_FANOUT": "1",
+             "HVD_TPU_RECONNECT_GRACE": "15",
+             "HVD_TPU_RECONNECT_DEADLINE": "15"},
+        doc="np=3 chain: the LEAF's uplink to its interior parent is "
+            "reset; it re-parents to the root and the stream replay "
+            "keeps every cache replica aligned"),
     # -- coordinator drain loop (ops/collective.py) ----------------------
     Scenario(
         "coord_tick_delay", "cp", "recover", cap=120.0,
@@ -209,10 +233,25 @@ def _free_port() -> int:
 # cp nodes: a real-process control-plane fleet (no XLA)
 # ---------------------------------------------------------------------------
 
-CP_STEPS = 40
 CP_TENSORS = 4
 CP_STEP_DEADLINE = 8.0
 _THRESHOLD = 1 << 20
+
+
+def _cp_steps() -> int:
+    """Steps per cp pass (env-overridable so the tier-1 tree leg can
+    run a short fleet; the matrix default stays 40)."""
+    return int(os.environ.get("HVD_TPU_CHAOS_CP_STEPS", "40"))
+
+
+def _cp_layout(np_: int):
+    """The tree layout the cp fleet runs under, or None for the flat
+    star — the SAME decision rule production init applies
+    (ops/tree.tree_active), so HVD_TPU_TREE=on in a scenario's env
+    turns the whole fleet into tree mode."""
+    from ..ops import tree as _tree
+
+    return _tree.build_layout(np_) if _tree.tree_active(np_) else None
 
 
 def _cp_req(rank: int, name: str):
@@ -244,7 +283,7 @@ def run_cp_controller(np_: int, port: int) -> None:
              if _cache_mod.cache_enabled() else None)
     coord = Coordinator(size=np_, fusion_threshold=_THRESHOLD,
                         cache=cache)
-    ctrl = T.ControllerTransport(coord, np_, port)
+    ctrl = T.ControllerTransport(coord, np_, port, tree=_cp_layout(np_))
     ctrl.cache = cache
     records = []
 
@@ -290,7 +329,9 @@ def run_cp_controller(np_: int, port: int) -> None:
     data_types = (ResponseType.ALLREDUCE, ResponseType.ALLGATHER,
                   ResponseType.BROADCAST, ResponseType.REDUCESCATTER,
                   ResponseType.ALLTOALL)
-    for step in range(CP_STEPS):
+    steps = _cp_steps()
+    pull_step = (3 * steps) // 4
+    for step in range(steps):
         for n in sorted(names):
             ctrl.submit(_cp_req(0, n))
         done: set = set()
@@ -312,22 +353,37 @@ def run_cp_controller(np_: int, port: int) -> None:
                 for n in sorted(names - done):
                     coord.withdraw(n, 0)
             time.sleep(0.002)
+        if step == pull_step:
+            # One fleet-wide metrics pull mid-run: under the tree this
+            # exercises the merged FRAME_METRICS_TREE aggregation (and
+            # after an interior fault, the re-parented paths); every
+            # live rank must answer.
+            snaps = ctrl.collect_metrics({"rank": 0}, timeout=10.0)
+            if len(snaps) < np_:
+                _diag(0, f"metrics pull covered only "
+                         f"{sorted(snaps)} of {np_} ranks")
     _result(0, records)
     ctrl.broadcast_responses([Response(ResponseType.SHUTDOWN)])
     time.sleep(0.3)  # let the workers drain the shutdown
     ctrl.close()
 
 
-def run_cp_worker(rank: int, port: int) -> None:
-    """Ranks 1..N-1 of the cp fleet: the real WorkerTransport +
-    response-cache replica, mirroring the worker half of
-    ops/collective._drain."""
+def run_cp_worker(rank: int, port: int, np_: int = 2) -> None:
+    """Ranks 1..N-1 of the cp fleet: the real WorkerTransport (or its
+    tree overlay when HVD_TPU_TREE arms it) + response-cache replica,
+    mirroring the worker half of ops/collective._drain."""
     from ..ops import cache as _cache_mod
     from ..ops import transport as T
     from ..ops.wire import ResponseType
 
     kill_step = int(os.environ.get("HVD_TPU_CHAOS_KILL_STEP", "-1"))
-    w = T.WorkerTransport("127.0.0.1", port, rank)
+    layout = _cp_layout(np_)
+    if layout is not None:
+        from ..ops import tree as _tree
+
+        w = _tree.TreeWorkerTransport("127.0.0.1", port, rank, layout)
+    else:
+        w = T.WorkerTransport("127.0.0.1", port, rank)
     if _cache_mod.cache_enabled() and w.controller_cache:
         w.cache = _cache_mod.ResponseCache(rank=rank)
     records = []
@@ -335,7 +391,7 @@ def run_cp_worker(rank: int, port: int) -> None:
     data_types = (ResponseType.ALLREDUCE, ResponseType.ALLGATHER,
                   ResponseType.BROADCAST, ResponseType.REDUCESCATTER,
                   ResponseType.ALLTOALL)
-    for step in range(CP_STEPS):
+    for step in range(_cp_steps()):
         if step == kill_step:
             sys.stderr.flush()
             os._exit(1)  # hard crash: no atexit handshake, no reconnect
@@ -607,6 +663,10 @@ def _run_pass(s: Scenario, faulted: bool) -> PassResult:
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)]
     else:
         port = _free_port()
+        # Tree mode: interiors bind relay ports at base+rank; a fresh
+        # base per pass keeps parallel passes from colliding (harmless
+        # for flat fleets, which never bind them).
+        tree_base = _free_port()
         procs = []
         for rank in range(s.np):
             procs.append(subprocess.Popen(
@@ -614,7 +674,9 @@ def _run_pass(s: Scenario, faulted: bool) -> PassResult:
                  "--node", str(rank), "--np", str(s.np),
                  "--port", str(port), "--scenario", s.name],
                 env=_child_env(s, faulted,
-                               {"HVD_TPU_RANK": str(rank)}),
+                               {"HVD_TPU_RANK": str(rank),
+                                "HVD_TPU_TREE_PORT_BASE":
+                                    str(tree_base)}),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
             if rank == 0:
                 time.sleep(0.2)  # let the controller bind first

@@ -16,6 +16,9 @@ Sites are the runtime's REAL failure boundaries (docs/chaos.md):
                       frame                            (peer reset)
   transport.stall     header sent, long pause, then
                       the body                         (slow peer)
+  tree.relay_reset    an interior tree node's child
+                      link is hard-closed before a
+                      downward relay (ops/tree.py)     (interior death)
   coord.tick_delay    sleep before a drain tick        (starved thread)
   coord.reorder       permute a tick's freshly
                       negotiated responses             (jittery fusion)
@@ -64,6 +67,7 @@ VALID_SITES = (
     "transport.trunc",
     "transport.reset",
     "transport.stall",
+    "tree.relay_reset",
     "coord.tick_delay",
     "coord.reorder",
     "ckpt.oserror",

@@ -179,12 +179,14 @@ def init(devices=None) -> None:
     # rank and the divergent knobs.)
     from .. import chaos as _chaos_env
     from ..ops import compression as _compression_env
+    from ..ops import tree as _tree_env
     from ..parallel import overlap as _overlap_env
     from . import topology as _topology_env
 
     _compression_env.validate_env()
     _topology_env.validate_env()
     _overlap_env.validate_env()
+    _tree_env.validate_env()
     # hvd-chaos: a typo'd HVD_TPU_FAULTS clause must abort init with
     # the valid site/key list, not silently run a fault-free "chaos"
     # job (docs/chaos.md).
@@ -257,6 +259,16 @@ def init(devices=None) -> None:
                     "jax.distributed is active but no HVD_TPU_COORDINATOR/"
                     "JAX_COORDINATOR_ADDRESS is visible; the eager control "
                     "plane needs it to locate the rank-0 controller.")
+            # Tree overlay (ops/tree.py, ROADMAP "thousand-rank control
+            # plane"): above HVD_TPU_TREE_THRESHOLD ranks the star
+            # becomes a fanout-ary tree — interiors aggregate their
+            # subtree's control traffic and relay broadcasts, so rank
+            # 0's per-tick frame count drops from O(world) to O(fanout).
+            from ..ops import tree as _tree
+
+            layout = (_tree.build_layout(_state.process_count)
+                      if _tree.tree_active(_state.process_count)
+                      else None)
             if _state.process_index == 0:
                 _state.coordinator = Coordinator(
                     size=_state.process_count,
@@ -266,13 +278,18 @@ def init(devices=None) -> None:
                 )
                 _state.transport = _transport.ControllerTransport(
                     _state.coordinator, _state.process_count,
-                    spec.controller_port)
+                    spec.controller_port, tree=layout)
                 _state.topology = _state.transport.topology[0]
             else:
                 _state.coordinator = None
-                _state.transport = _transport.WorkerTransport(
-                    spec.controller_host, spec.controller_port,
-                    _state.process_index)
+                if layout is not None:
+                    _state.transport = _tree.TreeWorkerTransport(
+                        spec.controller_host, spec.controller_port,
+                        _state.process_index, layout)
+                else:
+                    _state.transport = _transport.WorkerTransport(
+                        spec.controller_host, spec.controller_port,
+                        _state.process_index)
                 _state.topology = _state.transport.topology
                 if not _state.transport.controller_cache:
                     # Rank 0 advertised no response cache (its env

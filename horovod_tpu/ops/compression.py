@@ -748,6 +748,10 @@ _SPMD_ENV_KNOBS = (
     # bucketed sub-programs — so a rank diverging on it must be named
     # at startup exactly like the compression/topology knobs.
     "HVD_TPU_OVERLAP",
+    # Tree control-plane overlay (ops/tree.py): these select the wire
+    # conversation itself (who connects to whom, which frames flow), so
+    # a divergent rank would deadlock the handshake — name it at init.
+    "HVD_TPU_TREE", "HVD_TPU_TREE_FANOUT", "HVD_TPU_TREE_THRESHOLD",
 )
 
 

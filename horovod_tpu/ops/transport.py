@@ -144,6 +144,35 @@ FRAME_TRACE = 15          # hvd-trace span pull (trace/merge.py):
                           # Round-keyed like FRAME_METRICS so a
                           # straggler buffer from a timed-out pull
                           # never completes a later one
+# -- tree-overlay frames (ops/tree.py, docs/performance.md
+# -- "Scale-out control plane") ----------------------------------------
+FRAME_HELLO_TREE = 16     # child→parent at handshake: <H n> + n x
+                          # (<i rank><H hlen><host><H flen><fp>) — one
+                          # connection's whole-subtree HELLO, merged
+                          # bottom-up so the root sees fanout
+                          # connections instead of world-1
+FRAME_TOPO_TREE = 17      # parent→child, answering HELLO_TREE:
+                          # <B cache><H n> + n x (<i rank><iiii topo>)
+                          # — the subtree's placement slice; interiors
+                          # forward each child its own sub-slice
+FRAME_SUBTREE_BATCH = 18  # child→parent, one per relay tick: the
+                          # subtree's merged negotiation traffic as
+                          # typed sections (tree.py owns the layout) —
+                          # cache-hit bit-vectors grouped by (epoch,
+                          # entries) across ranks, per-rank full
+                          # requests, per-rank trace arrivals, and
+                          # cumulative per-rank frame counts for the
+                          # re-parent resume protocol
+FRAME_METRICS_TREE = 19   # child→parent: <I round><H n> + n x
+                          # (<i rank><I len><json>) — a subtree's
+                          # merged FRAME_METRICS replies, so a pull
+                          # costs the root fanout frames, not world
+FRAME_TRACE_TREE = 20     # child→parent: same layout as METRICS_TREE
+                          # for FRAME_TRACE span-buffer replies
+FRAME_CHILD_LOST = 21     # child→parent: <i rank><H len><reason> — an
+                          # interior's child link died and its grace
+                          # expired; only the ROOT arbitrates liveness
+                          # (the rank may have re-parented meanwhile)
 
 _FRAME_NAMES = {
     FRAME_HELLO: "HELLO", FRAME_REQUEST: "REQUEST",
@@ -154,6 +183,10 @@ _FRAME_NAMES = {
     FRAME_RESPONSE_BATCH: "RESPONSE_BATCH", FRAME_METRICS: "METRICS",
     FRAME_RECONNECT: "RECONNECT", FRAME_RESUME: "RESUME",
     FRAME_PING: "PING", FRAME_PONG: "PONG", FRAME_TRACE: "TRACE",
+    FRAME_HELLO_TREE: "HELLO_TREE", FRAME_TOPO_TREE: "TOPO_TREE",
+    FRAME_SUBTREE_BATCH: "SUBTREE_BATCH",
+    FRAME_METRICS_TREE: "METRICS_TREE",
+    FRAME_TRACE_TREE: "TRACE_TREE", FRAME_CHILD_LOST: "CHILD_LOST",
 }
 
 
@@ -199,6 +232,19 @@ _M_REPLAYED = _telemetry.counter(
 _M_FRAME_TIMEOUTS = _telemetry.counter(
     "transport.frame_timeouts", "mid-frame read deadlines exceeded "
     "(slow/stalled peer)")
+# Tree-overlay counters (ops/tree.py, docs/metrics.md).
+_M_TREE_MERGED = _telemetry.counter(
+    "transport.tree_merged_frames", "child control frames dissolved "
+    "into merged FRAME_SUBTREE_BATCH / *_TREE envelopes")
+_M_TREE_RELAYED = _telemetry.counter(
+    "transport.tree_relayed_frames", "broadcast frames an interior "
+    "node relayed down to its children")
+_M_REPARENTS = _telemetry.counter(
+    "transport.reparents", "orphaned tree ranks the root adopted as "
+    "direct children after their interior parent died")
+_M_CHILD_LOST = _telemetry.counter(
+    "transport.tree_child_lost", "FRAME_CHILD_LOST reports interiors "
+    "escalated to the root")
 
 
 # -- env knobs (hvd-chaos hardening; read at call time so tests and the
@@ -356,16 +402,21 @@ def _apply_send_chaos(sock: socket.socket, ftype: int,
 
 
 def _send_frame_or_fault(sock: socket.socket, ftype: int,
-                         payload: bytes = b"") -> int:
+                         payload: bytes = b"",
+                         allow_dup: bool = True) -> int:
     """The steady-state send: chaos consultation + the real send.
     Returns the number of stream slots the frame consumed on the wire
     (2 when chaos duplicated it) so the caller's replay ring stays
-    aligned with the receiver's frame count."""
+    aligned with the receiver's frame count.  ``allow_dup=False``
+    downgrades a chaos duplication into a plain send — the tree
+    overlay's broadcast stream uses it because a per-link dup would
+    desync the GLOBAL stream index the re-parent resume replays from
+    (docs/chaos.md)."""
     act = _apply_send_chaos(sock, ftype, payload)
     if act == "done":
         return 1
     _send_frame(sock, ftype, payload)
-    if act == "dup":
+    if act == "dup" and allow_dup:
         _send_frame(sock, ftype, payload)
         return 2
     return 1
@@ -434,12 +485,18 @@ def _check_env_fingerprint(rank: int, payload: bytes, offset: int) -> None:
     ``HVD_TPU_OVERLAP`` rides the same fingerprint: a rank running the
     bucketed-backward schedule against monolithic peers would submit a
     per-bucket collective program the others never produce."""
-    from . import compression as _compression
-
     if len(payload) < offset + 2:
         return  # pre-fingerprint HELLO (tests poking raw frames)
     (flen,) = struct.unpack_from("<H", payload, offset)
-    theirs = payload[offset + 2:offset + 2 + flen].decode("utf-8")
+    _check_env_fingerprint_str(
+        rank, payload[offset + 2:offset + 2 + flen].decode("utf-8"))
+
+
+def _check_env_fingerprint_str(rank: int, theirs: str) -> None:
+    """String-level half of :func:`_check_env_fingerprint` — the tree
+    handshake carries fingerprints pre-parsed per subtree entry."""
+    from . import compression as _compression
+
     mine = _compression.env_fingerprint()
     if theirs == mine:
         return
@@ -500,6 +557,11 @@ class _PeerSession:
     # expire_grace must not declare the rank dead out from under a
     # resume that is about to complete (the boundary-timing race).
     resuming: bool = False
+    # Tree mode: every rank this connection's subtree covers (incl.
+    # the direct child itself); a covered rank that re-parents moves
+    # into its own session.  Flat mode: just {rank}.  Mutated under
+    # ControllerTransport._lock like the socket/grace fields.
+    covers: set = field(default_factory=set)
 
 
 class ControllerTransport:
@@ -507,8 +569,24 @@ class ControllerTransport:
     the in-process coordinator, broadcasts Response lists to everyone."""
 
     def __init__(self, coordinator, num_processes: int, port: int,
-                 hostname: Optional[str] = None):
+                 hostname: Optional[str] = None, tree=None):
         self.coordinator = coordinator
+        # Tree overlay (ops/tree.py TreeLayout) or None for the flat
+        # star.  In tree mode the root accepts only its direct
+        # children; each connection's HELLO_TREE covers a whole
+        # subtree, every broadcast goes into ONE shared ring (the
+        # downward stream is identical on every path, which is what
+        # lets an orphaned rank re-parent here and resume from the
+        # global stream index), and per-rank upward frame counts come
+        # from the interiors' merged envelopes.
+        self.tree = tree
+        self._bcast_ring = _FrameRing(_ring_limit()) if tree is not None \
+            else None
+        # Tree mode: logical upward frames processed per ORIGIN rank —
+        # direct links count link frames, routed ranks count via the
+        # cumulative counts interiors fold into their envelopes.
+        # guarded_by: _lock
+        self._rank_rx: Dict[int, int] = {}
         # Shared response-cache replica (ops/cache.py), attached by
         # core.state.init after construction; None = caching disabled.
         self.cache = None
@@ -573,13 +651,18 @@ class ControllerTransport:
 
         hosts = {0: hostname or socket.gethostname()}
         socks: Dict[int, socket.socket] = {}
+        # rank of the direct child -> set of ranks its subtree covers
+        # (tree mode; flat mode every connection covers itself only).
+        coverage: Dict[int, set] = {}
         # Bound the wait for stragglers so a worker that died between the
         # jax.distributed rendezvous and its HELLO produces an error naming
         # the missing ranks instead of a silent hang.
         accept_timeout = float(
             os.environ.get("HVD_TPU_CONNECT_TIMEOUT", "120"))
         self._srv.settimeout(accept_timeout)
-        for _ in range(num_processes - 1):
+        expected_links = (len(tree.children(0)) if tree is not None
+                          else num_processes - 1)
+        for _ in range(expected_links):
             try:
                 conn, _addr = self._srv.accept()
             except socket.timeout:
@@ -590,6 +673,21 @@ class ControllerTransport:
                     f"startup?") from None
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             ftype, payload = _recv_frame(conn)
+            if tree is not None:
+                if ftype != FRAME_HELLO_TREE:
+                    raise RuntimeError(
+                        f"controller expected HELLO_TREE, got frame "
+                        f"type {ftype}")
+                from . import tree as _tree_mod
+
+                entries = _tree_mod.parse_hello_tree(payload)
+                child = entries[0][0]  # subtree root connects itself
+                coverage[child] = {r for r, _h, _fp in entries}
+                for rank, host, fp in entries:
+                    hosts[rank] = host
+                    _check_env_fingerprint_str(rank, fp)
+                socks[child] = conn
+                continue
             if ftype != FRAME_HELLO:
                 raise RuntimeError(
                     f"controller expected HELLO, got frame type {ftype}")
@@ -598,15 +696,31 @@ class ControllerTransport:
             hosts[rank] = payload[6:6 + hlen].decode("utf-8")
             _check_env_fingerprint(rank, payload, 6 + hlen)
             socks[rank] = conn
+            coverage[rank] = {rank}
+        if len(hosts) != num_processes:
+            missing = sorted(set(range(num_processes)) - set(hosts))
+            raise RuntimeError(
+                f"controller: tree handshake left ranks {missing} "
+                f"uncovered (HVD_TPU_TREE_FANOUT mismatch across "
+                f"ranks?)")
         from . import cache as _cache_mod
 
         self.topology = _assign_topology(hosts)
+        cache_flag = 1 if _cache_mod.cache_enabled() else 0
         for rank, conn in socks.items():
-            t = self.topology[rank]
-            _send_frame(conn, FRAME_TOPO, struct.pack(
-                "<iiiii", t.local_rank, t.local_size,
-                t.cross_rank, t.cross_size,
-                1 if _cache_mod.cache_enabled() else 0))
+            if tree is not None:
+                from . import tree as _tree_mod
+
+                _send_frame(conn, FRAME_TOPO_TREE,
+                            _tree_mod.pack_topo_tree(
+                                cache_flag,
+                                [(r, self.topology[r])
+                                 for r in sorted(coverage[rank])]))
+            else:
+                t = self.topology[rank]
+                _send_frame(conn, FRAME_TOPO, struct.pack(
+                    "<iiiii", t.local_rank, t.local_size,
+                    t.cross_rank, t.cross_size, cache_flag))
         with self._lock:
             for rank, conn in socks.items():
                 # Frame deadlines arm AFTER the handshake: idleness
@@ -614,7 +728,8 @@ class ControllerTransport:
                 # the peer (FrameDeadlineError).
                 conn.settimeout(_frame_timeout())
                 self._sess[rank] = _PeerSession(
-                    rank=rank, conn=conn, ring=_FrameRing(_ring_limit()))
+                    rank=rank, conn=conn, ring=_FrameRing(_ring_limit()),
+                    covers=set(coverage[rank]))
         for rank in socks:
             self._start_rx(rank, socks[rank])
         # Session-resume listener: the server socket stays open so a
@@ -741,7 +856,7 @@ class ControllerTransport:
             return
         now = time.monotonic()
         with self._lock:
-            for sess in self._sess.values():
+            for sess in list(self._sess.values()):
                 if (sess.grace_deadline is not None
                         and not sess.resuming
                         and now > sess.grace_deadline
@@ -755,6 +870,30 @@ class ControllerTransport:
                     _flight.record("grace_expired", sess.rank)
                     print(f"ERROR: rank {sess.rank}: {reason}",
                           file=sys.stderr)
+                    # Tree mode: the expired link covered a subtree.
+                    # The covered ranks are probably mid-re-parent —
+                    # give each its OWN grace window instead of an
+                    # instant death sentence; a rank that neither
+                    # re-parents nor is re-reported becomes lost with
+                    # a diagnostic naming the interior (bounded at
+                    # 2x grace end to end).
+                    for crank in sorted(sess.covers - {sess.rank}):
+                        if (crank in self.lost_ranks
+                                or crank in self._sess):
+                            continue
+                        orphan = _PeerSession(
+                            rank=crank, conn=None, ring=_FrameRing(1),
+                            covers={crank},
+                            grace_deadline=now + _grace_seconds(),
+                            disc_epoch=(self.cache.epoch
+                                        if self.cache is not None
+                                        else -1))
+                        self._sess[crank] = orphan
+                        print(f"[hvd-tree] controller: rank {crank} "
+                              f"was routed via lost rank {sess.rank}; "
+                              f"holding {_grace_seconds():.1f}s for a "
+                              f"re-parent", file=sys.stderr)
+                    sess.covers = {sess.rank}
 
     def _handle_reconnect(self, conn: socket.socket,
                           payload: bytes) -> None:
@@ -766,14 +905,40 @@ class ControllerTransport:
         can interleave ahead of the replayed suffix."""
         rank, their_rx, epoch, has_cache = struct.unpack_from(
             "<iIiB", payload)
+        adopted = False
         with self._lock:
             sess = self._sess.get(rank)
             lost = rank in self.lost_ranks
+            if (sess is None and not lost and self.tree is not None
+                    and 0 < rank < self.num_processes):
+                # Tree re-parent: a rank routed via an interior lost
+                # its parent and is reconnecting to the root directly.
+                # Adopt it as a direct child — the shared broadcast
+                # ring replays the downward suffix it missed (the
+                # stream is identical on every path), and its own
+                # outgoing ring replays the upward suffix the dead
+                # interior swallowed (duplicate submits/bits are
+                # idempotent by design).
+                for other in self._sess.values():
+                    other.covers.discard(rank)
+                sess = _PeerSession(
+                    rank=rank, conn=None, ring=_FrameRing(1),
+                    covers={rank},
+                    disc_epoch=(self.cache.epoch
+                                if self.cache is not None else -1))
+                self._sess[rank] = sess
+                adopted = True
         if sess is None or lost:
             why = (self.lost_reasons.get(rank, "declared dead")
                    if lost else "unknown rank")
             self._reject_reconnect(conn, rank, why)
             return
+        if adopted:
+            _M_REPARENTS.inc()
+            _flight.record("tree_reparent", rank)
+            print(f"[hvd-tree] controller: adopting rank {rank} as a "
+                  f"direct child (re-parented after interior loss)",
+                  file=sys.stderr)
         # Shield the session from expire_grace while the resume is in
         # flight: a reconnect landing near the grace deadline must not
         # be completed here while the drain tick concurrently declares
@@ -809,10 +974,12 @@ class ControllerTransport:
         if rx_th is not None and rx_th is not threading.current_thread():
             rx_th.join(timeout=5.0)
         with self._send_lock:
-            suffix = sess.ring.since(their_rx)
+            ring = self._bcast_ring if self.tree is not None \
+                else sess.ring
+            suffix = ring.since(their_rx)
             if suffix is None:
                 reason = (f"cannot resume rank {rank}: it received "
-                          f"{their_rx} of {sess.ring.count} frames but "
+                          f"{their_rx} of {ring.count} frames but "
                           f"the replay ring no longer holds that "
                           f"suffix (gap beyond HVD_TPU_RECONNECT_RING)")
                 with self._lock:
@@ -821,16 +988,34 @@ class ControllerTransport:
                         "reconnect replay ring overflow"
                 self._reject_reconnect(conn, rank, reason)
                 return
-            drop_cache = bool(has_cache) and (
-                self.cache is None or epoch != sess.disc_epoch)
+            if self.tree is not None:
+                # Tree mode: the GLOBAL broadcast stream replay applies
+                # any missed flush markers in order, so a replica at an
+                # OLDER epoch re-converges deterministically; only a
+                # bogus future epoch (or no controller cache) drops it.
+                live_epoch = (self.cache.epoch
+                              if self.cache is not None else -1)
+                drop_cache = bool(has_cache) and (
+                    self.cache is None or epoch > live_epoch)
+                reason = (f"cache epoch {epoch} ahead of controller "
+                          f"epoch {live_epoch}; resume cache-less"
+                          if drop_cache else "")
+            else:
+                drop_cache = bool(has_cache) and (
+                    self.cache is None or epoch != sess.disc_epoch)
+                reason = (f"cache epoch {epoch} != disconnect-time "
+                          f"epoch {sess.disc_epoch}; resume cache-less"
+                          if drop_cache else "")
             verdict = 2 if drop_cache else 1
-            reason = (f"cache epoch {epoch} != disconnect-time epoch "
-                      f"{sess.disc_epoch}; resume cache-less"
-                      if drop_cache else "")
             rb = reason.encode("utf-8")
+            if self.tree is not None:
+                with self._lock:
+                    rx_report = self._rank_rx.get(rank, 0)
+            else:
+                rx_report = sess.rx_count
             try:
                 _send_frame(conn, FRAME_RESUME,
-                            struct.pack("<IBH", sess.rx_count, verdict,
+                            struct.pack("<IBH", rx_report, verdict,
                                         len(rb)) + rb)
                 for ftype, fpayload in suffix:
                     _send_frame(conn, ftype, fpayload)
@@ -918,7 +1103,48 @@ class ControllerTransport:
                     self._mark_disconnected(sess, "eof")
                 return
             sess.rx_count += 1
-            if ftype == FRAME_REQUEST:
+            if self.tree is not None:
+                # Per-origin logical frame count (the re-parent resume
+                # protocol's upward half): a direct link's frames count
+                # against the link's own rank; dissolved child frames
+                # arrive via the envelopes' counts sections.
+                with self._lock:
+                    self._rank_rx[rank] = self._rank_rx.get(rank, 0) + 1
+            if ftype == FRAME_SUBTREE_BATCH:
+                self._handle_subtree_batch(payload)
+            elif ftype == FRAME_METRICS_TREE:
+                from . import tree as _tree_mod
+
+                rnd, entries = _tree_mod.parse_merged_pull(payload)
+                with self._met_cond:
+                    if rnd in self._met_payloads:
+                        for erank, blob in entries:
+                            try:
+                                snap = json.loads(blob.decode("utf-8"))
+                            except (ValueError, UnicodeDecodeError):
+                                snap = {}
+                            self._met_payloads[rnd][erank] = snap
+                        self._met_cond.notify_all()
+            elif ftype == FRAME_TRACE_TREE:
+                from . import tree as _tree_mod
+
+                rnd, entries = _tree_mod.parse_merged_pull(payload)
+                with self._trc_cond:
+                    if rnd in self._trc_payloads:
+                        for erank, blob in entries:
+                            try:
+                                evs = json.loads(blob.decode("utf-8"))
+                            except (ValueError, UnicodeDecodeError):
+                                evs = []
+                            self._trc_payloads[rnd][erank] = \
+                                evs if isinstance(evs, list) else []
+                        self._trc_cond.notify_all()
+            elif ftype == FRAME_CHILD_LOST:
+                (crank,) = struct.unpack_from("<i", payload)
+                (rlen,) = struct.unpack_from("<H", payload, 4)
+                reason = payload[6:6 + rlen].decode("utf-8")
+                self._handle_child_lost(crank, reason)
+            elif ftype == FRAME_REQUEST:
                 req, _ = Request.unpack(payload)
                 if not self._try_submit(req):
                     # Registration race: the worker's set request can
@@ -999,23 +1225,12 @@ class ControllerTransport:
         (nreq,) = struct.unpack_from("<H", payload, off)
         off += 2
         _flight.record("frame_rx_batch", srank, epoch, nreq)
-        cache = self.cache
         for byte_i, b in enumerate(bitvec):
             while b:
                 low = b & -b
                 idx = byte_i * 8 + low.bit_length() - 1
                 b ^= low
-                if cache is None:
-                    print(f"WARNING: rank {srank} sent a response-cache "
-                          f"bit but the controller cache is disabled "
-                          f"(HVD_TPU_RESPONSE_CACHE mismatch across "
-                          f"ranks?)", file=sys.stderr)
-                    continue
-                down = cache.hit_from_wire(idx, srank, epoch)
-                if down is not None and not self._try_submit(down):
-                    with self._lock:
-                        self._unrouted.append(
-                            (time.monotonic() + 5.0, down))
+                self._account_bit(idx, srank, epoch)
         for _ in range(nreq):
             req, off = Request.unpack(payload, off)
             if not self._try_submit(req):
@@ -1027,6 +1242,89 @@ class ControllerTransport:
         ctx = _trace.unpack_ctx(payload, off)
         if ctx is not None:
             _trace.note_batch_arrival(srank, ctx[0], ctx[1])
+
+    def _account_bit(self, idx: int, srank: int, epoch: int) -> None:
+        """One worker cache-hit bit (flat frame or dissolved from a
+        subtree envelope): account it, or downgrade a stale-epoch bit
+        into a real submit of the retired entry's stored request."""
+        cache = self.cache
+        if cache is None:
+            print(f"WARNING: rank {srank} sent a response-cache bit "
+                  f"but the controller cache is disabled "
+                  f"(HVD_TPU_RESPONSE_CACHE mismatch across ranks?)",
+                  file=sys.stderr)
+            return
+        down = cache.hit_from_wire(idx, srank, epoch)
+        if down is not None and not self._try_submit(down):
+            with self._lock:
+                self._unrouted.append((time.monotonic() + 5.0, down))
+
+    def _handle_subtree_batch(self, payload: bytes) -> None:
+        """One merged subtree envelope (tree overlay): the interiors'
+        per-tick aggregation of their subtree's FRAME_REQUEST_BATCH
+        traffic.  Sections dissolve into the IDENTICAL per-bit /
+        per-request processing the flat path runs, so the negotiation
+        outcome — and with it the broadcast response stream every cache
+        replica is aligned by — is byte-for-byte the flat one."""
+        from . import tree as _tree_mod
+
+        nbits = nreqs = 0
+        for sec in _tree_mod.iter_subtree_sections(payload):
+            kind = sec[0]
+            if kind == "bits":
+                _kind, epoch, ranks, idxs = sec
+                for srank in ranks:
+                    for idx in idxs:
+                        self._account_bit(idx, srank, epoch)
+                    nbits += len(idxs)
+            elif kind == "reqs":
+                _kind, srank, reqs = sec
+                for req in reqs:
+                    nreqs += 1
+                    if not self._try_submit(req):
+                        with self._lock:
+                            self._unrouted.append(
+                                (time.monotonic() + 5.0, req))
+            elif kind == "arrival":
+                _kind, srank, ctx = sec
+                if ctx is not None:
+                    _trace.note_batch_arrival(srank, ctx[0], ctx[1])
+            elif kind == "counts":
+                with self._lock:
+                    for srank, cum in sec[1].items():
+                        if cum > self._rank_rx.get(srank, 0):
+                            self._rank_rx[srank] = cum
+        _flight.record("frame_rx_subtree", nbits, nreqs)
+
+    def _handle_child_lost(self, crank: int, reason: str) -> None:
+        """An interior reported a dead child link.  Only the root
+        arbitrates liveness: the rank may have re-parented here in the
+        meantime (its own live session wins), otherwise it gets its own
+        grace window — re-parent within it or become a dead peer with
+        the interior's diagnostic."""
+        _M_CHILD_LOST.inc()
+        with self._lock:
+            sess = self._sess.get(crank)
+            if crank in self.lost_ranks:
+                return
+            if sess is not None and (sess.conn is not None
+                                     or sess.resuming):
+                return  # already re-parented; the report is stale
+            for other in self._sess.values():
+                other.covers.discard(crank)
+            if sess is None:
+                sess = _PeerSession(
+                    rank=crank, conn=None, ring=_FrameRing(1),
+                    covers={crank})
+                self._sess[crank] = sess
+            if sess.grace_deadline is None:
+                sess.grace_deadline = time.monotonic() + _grace_seconds()
+                sess.disc_epoch = (self.cache.epoch
+                                   if self.cache is not None else -1)
+        _flight.record("tree_child_lost", crank, reason)
+        print(f"[hvd-tree] controller: interior reported rank {crank} "
+              f"unreachable ({reason}); holding {_grace_seconds():.1f}s "
+              f"for a re-parent", file=sys.stderr)
 
     def _route_coord(self, psid: int):
         """Coordinator for a process-set id (0 = global); None when the
@@ -1175,8 +1473,12 @@ class ControllerTransport:
         one NEW sample (or the timeout lapses — a dead peer must not
         stall the dump).  Returns the refreshed offsets."""
         with self._lock:
-            live = [s.rank for s in self._sess.values()
-                    if s.conn is not None]
+            live: set = set()
+            for s in self._sess.values():
+                if s.conn is not None:
+                    # Tree mode: a live link reaches its whole subtree.
+                    live |= (s.covers or {s.rank})
+            live.discard(0)
         before = self.clock.sample_counts()
         deadline = time.monotonic() + timeout
         for i in range(max(1, probes)):
@@ -1258,6 +1560,24 @@ class ControllerTransport:
         with self._send_lock:
             with self._lock:
                 sessions = list(self._sess.values())
+            if self.tree is not None:
+                # Tree mode: ONE shared ring — every path relays the
+                # identical broadcast stream, so any rank (direct child
+                # or re-parented orphan) resumes from its global stream
+                # index.  Chaos dup is downgraded on these links: a
+                # per-link duplicate would desync that index.
+                self._bcast_ring.append(ftype, payload)
+                for sess in sessions:
+                    conn = sess.conn
+                    if conn is None:
+                        continue
+                    try:
+                        _send_frame_or_fault(conn, ftype, payload,
+                                             allow_dup=False)
+                    except OSError as e:
+                        self._mark_disconnected(sess,
+                                                f"send failed: {e}")
+                return
             for sess in sessions:
                 sess.ring.append(ftype, payload)
                 conn = sess.conn
@@ -1382,6 +1702,27 @@ class WorkerTransport:
                 time.sleep(delay)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._handshake(hostname)
+        # Frame deadlines arm after the handshake (see the controller's
+        # mirror): idle-between-frames is legal, a mid-frame stall
+        # names the controller and the frame type.
+        self._sock.settimeout(_frame_timeout())
+        self._rx = threading.Thread(target=self._recv_loop,
+                                    name=f"hvd-worker-rx-{rank}", daemon=True)
+        self._rx.start()
+        # Exit handshake (≙ the reference's DONE/shutdown flag on the last
+        # MPIRequestList, mpi_message.h:87-103): a worker whose interpreter
+        # exits without an explicit hvd.shutdown() still tells the
+        # controller it left *cleanly*.  An EOF without this frame is
+        # therefore always a crash.  Registered after jax.distributed
+        # initialize, so (atexit LIFO) it runs before jax's exit barrier.
+        atexit.register(self._atexit_handshake)
+
+    def _handshake(self, hostname: Optional[str]) -> None:
+        """HELLO → TOPO exchange on the fresh socket (overridden by the
+        tree overlay, which speaks HELLO_TREE / TOPO_TREE and must
+        collect its children's hellos first — ops/tree.py)."""
+        rank = self.rank
         hb = (hostname or socket.gethostname()).encode("utf-8")
         from . import compression as _compression
 
@@ -1401,20 +1742,6 @@ class WorkerTransport:
         self.controller_cache = bool(struct.unpack_from(
             "<i", payload, 16)[0]) if len(payload) >= 20 else True
         self.topology = Topology(lr, ls, cr, cs)
-        # Frame deadlines arm after the handshake (see the controller's
-        # mirror): idle-between-frames is legal, a mid-frame stall
-        # names the controller and the frame type.
-        self._sock.settimeout(_frame_timeout())
-        self._rx = threading.Thread(target=self._recv_loop,
-                                    name=f"hvd-worker-rx-{rank}", daemon=True)
-        self._rx.start()
-        # Exit handshake (≙ the reference's DONE/shutdown flag on the last
-        # MPIRequestList, mpi_message.h:87-103): a worker whose interpreter
-        # exits without an explicit hvd.shutdown() still tells the
-        # controller it left *cleanly*.  An EOF without this frame is
-        # therefore always a crash.  Registered after jax.distributed
-        # initialize, so (atexit LIFO) it runs before jax's exit barrier.
-        atexit.register(self._atexit_handshake)
 
     def _atexit_handshake(self) -> None:
         # Sent even when a shutdown was already received (it's idempotent):
@@ -1504,6 +1831,11 @@ class WorkerTransport:
                 self._poison(why)
                 return
             self._rx_count += 1
+            # Tree overlay hook: an interior node relays every
+            # broadcast frame to its children BEFORE local processing,
+            # so each child's downward stream is the root's, verbatim
+            # (no-op on leaves / flat workers).
+            self._relay_downward(ftype, payload)
             if ftype == FRAME_RESPONSE_BATCH:
                 epoch, ngroups = struct.unpack_from("<IH", payload)
                 off = 6
@@ -1547,13 +1879,10 @@ class WorkerTransport:
                 # pull can never complete a later one.  Snapshot +
                 # serialization run on this receive thread — collectors
                 # only read cheap stats structs, nothing blocks.
+                # (Interior tree nodes override _answer_metrics to
+                # aggregate their subtree's replies into one frame.)
                 (rnd,) = struct.unpack_from("<I", payload)
-                try:
-                    body = json.dumps(_telemetry.metrics()).encode("utf-8")
-                except Exception:  # noqa: BLE001 — must answer regardless
-                    body = b"{}"
-                self._send(FRAME_METRICS,
-                           struct.pack("<iI", self.rank, rnd) + body)
+                self._answer_metrics(rnd)
                 continue
             if ftype == FRAME_PING:
                 # hvd-trace clock probe: stamp the receipt FIRST so
@@ -1569,13 +1898,7 @@ class WorkerTransport:
                 # hvd-trace span pull: answer with this rank's buffer,
                 # echoing the round (the FRAME_METRICS discipline).
                 (rnd,) = struct.unpack_from("<I", payload)
-                try:
-                    body = json.dumps(
-                        _trace.export_events()).encode("utf-8")
-                except Exception:  # noqa: BLE001 — must answer anyway
-                    body = b"[]"
-                self._send(FRAME_TRACE,
-                           struct.pack("<iI", self.rank, rnd) + body)
+                self._answer_trace(rnd)
                 continue
             if ftype == FRAME_RESPONSES:
                 resps, off = wire.unpack_response_list_ex(payload)
@@ -1587,6 +1910,32 @@ class WorkerTransport:
                        for r in resps):
                     self.shutdown_received.set()
                 self._responses.put((resps, ctx))
+
+    def _relay_downward(self, ftype: int, payload: bytes) -> None:
+        """Tree-overlay hook (no-op here): interiors relay the frame to
+        their children verbatim before processing it locally."""
+
+    def _metrics_snapshot(self) -> bytes:
+        try:
+            return json.dumps(_telemetry.metrics()).encode("utf-8")
+        except Exception:  # noqa: BLE001 — must answer regardless
+            return b"{}"
+
+    def _trace_snapshot(self) -> bytes:
+        try:
+            return json.dumps(_trace.export_events()).encode("utf-8")
+        except Exception:  # noqa: BLE001 — must answer anyway
+            return b"[]"
+
+    def _answer_metrics(self, rnd: int) -> None:
+        self._send(FRAME_METRICS,
+                   struct.pack("<iI", self.rank, rnd)
+                   + self._metrics_snapshot())
+
+    def _answer_trace(self, rnd: int) -> None:
+        self._send(FRAME_TRACE,
+                   struct.pack("<iI", self.rank, rnd)
+                   + self._trace_snapshot())
 
     # -- session resume (hvd-chaos reconnect protocol) ---------------------
     def _drop_cache_replica(self) -> None:

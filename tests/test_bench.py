@@ -68,6 +68,17 @@ def test_bench_control_mode_contract_and_speedup():
     tr = payload["trace"]
     assert tr["trace_on"] > 0 and tr["trace_off"] > 0
     assert "overhead_pct" in tr and "overhead_ok" in tr
+    # Tree-overlay section (thousand-rank control plane): rank-0 rx
+    # frames per simulated cycle must be structurally sub-linear —
+    # one merged envelope per direct child, bounded by
+    # fanout*log_fanout(world) — at every simulated world size.
+    tree = payload["tree"]
+    assert {w["world"] for w in tree["worlds"]} == {64, 256, 1024}
+    for w in tree["worlds"]:
+        assert w["tree_frames_per_cycle"] <= 2 * w["fanout_log_bound"]
+        assert w["tree_frames_per_cycle"] * 4 \
+            <= w["flat_frames_per_cycle"]
+        assert w["negotiations_per_sec"] > 0
 
 
 def test_bench_dataplane_mode_contract_and_gates():

@@ -296,3 +296,202 @@ def test_compression_state_rides_checkpoints(hvd, tmp_path, monkeypatch):
     out_resumed = np.asarray(horovod_tpu.allreduce(x, average=False,
                                                    name="ckq"))
     assert out_next.tobytes() == out_resumed.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Sharded distributed checkpointing (docs/performance.md "Scale-out
+# control plane")
+# ---------------------------------------------------------------------------
+
+def _big_tree():
+    rng = np.random.default_rng(11)
+    return {
+        "layers": [
+            {"w": rng.standard_normal((16, 16)).astype("float32"),
+             "b": rng.standard_normal((16,)).astype("float32")}
+            for _ in range(3)
+        ],
+        "head": rng.standard_normal((16, 4)).astype("float64"),
+        "meta": {"epoch": 9, "name": "m"},
+    }
+
+
+def _zeros_like_big():
+    return {
+        "layers": [
+            {"w": np.zeros((16, 16), "float32"),
+             "b": np.zeros((16,), "float32")}
+            for _ in range(3)
+        ],
+        "head": np.zeros((16, 4), "float64"),
+        "meta": {"epoch": 0, "name": ""},
+    }
+
+
+def _assert_trees_bitwise(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+            assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+        else:
+            assert x == y
+
+
+def test_shard_assignment_deterministic_and_balanced():
+    sizes = [100, 90, 80, 10, 10, 10, 5, 0]
+    a1 = ck.shard_assignment(sizes, 3)
+    a2 = ck.shard_assignment(sizes, 3)
+    assert a1 == a2
+    load = [0, 0, 0]
+    for i, w in enumerate(a1):
+        load[w] += sizes[i]
+    assert max(load) - min(load) <= max(sizes)
+    # every writer gets used when there is enough work
+    assert set(a1) == {0, 1, 2}
+
+
+def test_sharded_save_restore_reshards_across_world_sizes(tmp_path):
+    """The tentpole gate: save under one world size, restore under
+    different ones, parameters bitwise-equal — no broadcast, no rank-0
+    byte funnel."""
+    d = str(tmp_path / "sharded")
+    tree_in = _big_tree()
+    h = ck.save_checkpoint_sharded(d, tree_in, step=2, world=2,
+                                   block=True)
+    assert bool(h) and h.done
+    man = ck.load_sharded_manifest(d)
+    assert man["world"] == 2 and man["format"] == ck.SHARDED_FORMAT
+    # shard files exist for both writer ranks of the declared layout
+    sd = os.path.join(d, man["save_dir"])
+    assert sorted(f for f in os.listdir(sd) if f.endswith(".msgpack")) \
+        == ["shard-00000-of-00002.msgpack", "shard-00001-of-00002.msgpack"]
+    # restore "at np=1" and "at np=4" (the layout is irrelevant at
+    # restore: every process reads what it needs from shared storage)
+    out1 = ck.restore_checkpoint_sharded(d, _zeros_like_big())
+    _assert_trees_bitwise(out1, tree_in)
+    ck.save_checkpoint_sharded(d, tree_in, step=3, world=4, block=True)
+    out4 = ck.restore_checkpoint_sharded(d, _zeros_like_big())
+    _assert_trees_bitwise(out4, tree_in)
+
+
+def test_sharded_torn_fleet_keeps_previous_checkpoint(tmp_path,
+                                                      monkeypatch):
+    """Mid-write kill of any single host: the manifest commit waits for
+    every shard sidecar, times out, and the MANIFEST pointer still
+    names the previous COMPLETE save — a partial save can never shadow
+    it."""
+    d = str(tmp_path / "torn")
+    good = _big_tree()
+    ck.save_checkpoint_sharded(d, good, step=1, world=2, block=True)
+    # Second save at world=2, but only "rank 0" of the fleet survives
+    # (virtual=False: strict per-rank shard writing; rank 1 never runs)
+    monkeypatch.setenv("HVD_TPU_CKPT_MANIFEST_TIMEOUT", "0.4")
+    bad = jax.tree_util.tree_map(
+        lambda x: x * 2 if isinstance(x, np.ndarray) else x, good)
+    h = ck.save_checkpoint_sharded(d, bad, step=2, world=2, rank=0,
+                                   virtual=False)
+    with pytest.raises(ck.CheckpointError, match="never became durable"):
+        h.wait(30.0)
+    man = ck.load_sharded_manifest(d)
+    assert man["step"] == 1  # pointer still the previous complete save
+    out = ck.restore_checkpoint_sharded(d, _zeros_like_big())
+    _assert_trees_bitwise(out, good)
+
+
+def test_sharded_two_rank_fleet_commit_order(tmp_path):
+    """np=2-style save driven rank by rank (strict mode): rank 0's
+    manifest commit only lands after rank 1's shard is durable — the
+    rank-0-committed-manifest contract without any collective."""
+    d = str(tmp_path / "fleet2")
+    tree_in = _big_tree()
+    # rank 1 writes its shard first, then rank 0 commits
+    h1 = ck.save_checkpoint_sharded(d, tree_in, step=5, world=2, rank=1,
+                                    virtual=False, block=True)
+    assert bool(h1)
+    assert not os.path.exists(os.path.join(d, "MANIFEST"))
+    h0 = ck.save_checkpoint_sharded(d, tree_in, step=5, world=2, rank=0,
+                                    virtual=False, block=True)
+    assert bool(h0)
+    out = ck.restore_checkpoint_sharded(d, _zeros_like_big())
+    _assert_trees_bitwise(out, tree_in)
+    assert ck.load_sharded_manifest(d)["shard_digests"].keys() == {"0",
+                                                                   "1"}
+
+
+def test_sharded_restore_rejects_corrupt_shard(tmp_path):
+    d = str(tmp_path / "corrupt")
+    ck.save_checkpoint_sharded(d, _big_tree(), step=1, world=2,
+                               block=True)
+    man = ck.load_sharded_manifest(d)
+    victim = os.path.join(d, man["save_dir"],
+                          "shard-00001-of-00002.msgpack")
+    with open(victim, "r+b") as f:
+        f.seek(0)
+        f.write(b"\xff\xff\xff\xff")
+    with pytest.raises(ck.CheckpointError, match="digest mismatch"):
+        ck.restore_checkpoint_sharded(d, _zeros_like_big())
+
+
+def test_restore_broadcast_skip_decision(monkeypatch):
+    """The broadcast-elision rule: skip only when EVERY rank gathered
+    the same non-None digest (checkpoint.broadcast_skipped counts it);
+    any missing or divergent local file falls back to the classic
+    rank-0 broadcast."""
+    calls = {}
+
+    def fake_allgather(obj, name=None):
+        calls["digest"] = obj
+        return calls["fleet"]
+
+    monkeypatch.setattr("horovod_tpu.ops.objects.allgather_object",
+                        fake_allgather)
+    calls["fleet"] = ["d1", "d1", "d1"]
+    assert ck._broadcast_skippable("d1")
+    calls["fleet"] = ["d1", "d2", "d1"]
+    assert not ck._broadcast_skippable("d1")
+    calls["fleet"] = ["d1", None, "d1"]
+    assert not ck._broadcast_skippable("d1")
+    calls["fleet"] = []
+    assert not ck._broadcast_skippable(None)
+
+
+def test_sharded_untagged_save_requires_step_in_mp(tmp_path):
+    """The tag must be fleet-agreed: an untagged save in strict
+    multi-rank mode is a contract error (a process-local counter
+    diverges across elastic restarts)."""
+    with pytest.raises(ValueError, match="requires step="):
+        ck.save_checkpoint_sharded(str(tmp_path / "x"), _big_tree(),
+                                   world=2, rank=0, virtual=False)
+
+
+def test_sharded_retry_ignores_stale_sidecars_from_torn_attempt(
+        tmp_path, monkeypatch):
+    """Torn-retry freshness: a save-<tag>/ left by a torn attempt (no
+    committed manifest) holds self-consistent shard+.ok pairs; a retry
+    under the same tag must NOT let the commit consume them until the
+    owning rank republishes — otherwise the manifest could mix
+    attempts (or record a digest mid-rewrite)."""
+    d = str(tmp_path / "retry")
+    tree_a = _big_tree()
+    # attempt 1, torn: rank 1 published, rank 0 (the committer) died
+    ck.save_checkpoint_sharded(d, tree_a, step=7, world=2, rank=1,
+                               virtual=False, block=True)
+    assert not os.path.exists(os.path.join(d, "MANIFEST"))
+    # age the leftover sidecar past the staleness margin (a real torn
+    # retry happens after a job restart, minutes later)
+    stale_ok = os.path.join(d, "save-s7",
+                            "shard-00001-of-00002.msgpack.ok")
+    past = time.time() - 3600
+    os.utime(stale_ok, (past, past))
+    # attempt 2 with DIFFERENT bytes: rank 0 runs, rank 1 never
+    # republishes -> the stale sidecar must not satisfy the commit
+    monkeypatch.setenv("HVD_TPU_CKPT_MANIFEST_TIMEOUT", "0.6")
+    tree_b = jax.tree_util.tree_map(
+        lambda x: x + 1 if isinstance(x, np.ndarray) else x, tree_a)
+    h = ck.save_checkpoint_sharded(d, tree_b, step=7, world=2, rank=0,
+                                   virtual=False)
+    with pytest.raises(ck.CheckpointError, match="never became durable"):
+        h.wait(30.0)
+    assert not os.path.exists(os.path.join(d, "MANIFEST"))
