@@ -105,6 +105,11 @@ from .parallel.data import (  # noqa: F401
 )
 from .parallel.input import prefetch_to_device  # noqa: F401
 from .parallel.overlap import ChainedLoss  # noqa: F401
+from .parallel.pipeline import (  # noqa: F401
+    PipelinePlan,
+    make_pipeline_train_step,
+    schedule_plan,
+)
 from .parallel.training import barrier_fence  # noqa: F401
 from . import elastic  # noqa: F401  (hvd.elastic.State / @hvd.elastic.run)
 from . import analysis  # noqa: F401  (hvd.analysis.verify_program & co)

@@ -41,6 +41,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 import socket
 import subprocess
 import sys
@@ -642,14 +643,19 @@ class PassResult:
     seconds: float
 
 
+_RESULT_RE = re.compile(
+    r"CHAOS_RESULT rank=(\d+) n=(\d+) digest=([0-9a-f]{24})")
+
+
 def _parse_results(output: str) -> Dict[int, str]:
+    # Matched by the exact field shapes (_result writes a 24-hex-char
+    # digest), not by line splitting: a concurrent writer on the same
+    # fd can interleave a log fragment mid-line (observed: a
+    # "[hvd-tree]" relay line glued onto a digest token under tier-1
+    # load), and that must not read as a digest mismatch.
     out: Dict[int, str] = {}
-    for line in output.splitlines():
-        if line.startswith("CHAOS_RESULT "):
-            fields = dict(kv.split("=", 1)
-                          for kv in line.split()[1:] if "=" in kv)
-            out[int(fields["rank"])] = \
-                f"n={fields['n']} digest={fields['digest']}"
+    for m in _RESULT_RE.finditer(output):
+        out[int(m.group(1))] = f"n={m.group(2)} digest={m.group(3)}"
     return out
 
 

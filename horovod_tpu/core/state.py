@@ -181,11 +181,13 @@ def init(devices=None) -> None:
     from ..ops import compression as _compression_env
     from ..ops import tree as _tree_env
     from ..parallel import overlap as _overlap_env
+    from ..parallel import pipeline as _pipeline_env
     from . import topology as _topology_env
 
     _compression_env.validate_env()
     _topology_env.validate_env()
     _overlap_env.validate_env()
+    _pipeline_env.validate_env()
     _tree_env.validate_env()
     # hvd-chaos: a typo'd HVD_TPU_FAULTS clause must abort init with
     # the valid site/key list, not silently run a fault-free "chaos"

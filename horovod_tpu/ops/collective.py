@@ -2698,6 +2698,57 @@ def poll(handle: int) -> bool:
     return st.handle_manager.poll(handle)
 
 
+def _wait_mp_result(st, h) -> None:
+    """Drain until a multi-process collective's response has been
+    executed locally (``h.result`` set) — completion depends on the
+    other processes, so this waits (with the background tick also
+    draining) up to a timeout, then withdraws GROUP-WIDE (round 4):
+    tell the coordinator we gave up so it broadcasts an ERROR response
+    and every rank fails this op within the grace window — instead of
+    each peer serially eating its own full timeout, or (the SPMD
+    hazard) this rank later skipping a broadcast response its peers
+    execute and block on.  Shared by :func:`synchronize` (which then
+    blocks on device completion) and :func:`take_async` (which
+    returns the in-flight array — the overlap path's mp partial
+    cycles ride this)."""
+    import os as _os
+    import time as _time
+
+    timeout = float(_os.environ.get("HOROVOD_TPU_SYNC_TIMEOUT", "300"))
+    deadline = _time.monotonic() + timeout
+    while h.result is None and _time.monotonic() < deadline:
+        _drain()
+        _time.sleep(0.001)
+    if h.result is None:
+        try:
+            w_ps = _queue.peek_ps(h.name)
+            if st.process_index == 0:
+                coord = (st.coordinator if w_ps is None
+                         else w_ps.coordinator)
+                coord.withdraw(h.name, 0)
+            else:
+                st.transport.withdraw(
+                    h.name,
+                    0 if w_ps is None else w_ps.process_set_id)
+        except (OSError, AttributeError):
+            pass  # controller unreachable: fall back to local
+        grace_dl = _time.monotonic() + float(_os.environ.get(
+            "HOROVOD_TPU_WITHDRAW_GRACE", "10"))
+        while h.result is None and _time.monotonic() < grace_dl:
+            _drain()
+            _time.sleep(0.001)
+    if h.result is None:
+        # Controller never answered the withdrawal: error locally
+        # so the name can be reused and the handle doesn't pin
+        # the contribution forever.
+        _queue.take([h.name])
+        h.result = HorovodError(
+            f"Collective {h.name} timed out after {timeout:.0f}s "
+            f"waiting for the remaining processes (see the "
+            f"coordinator's stall warnings for which ranks are "
+            f"missing).")
+
+
 def synchronize(handle: int):
     """Block until the collective completes and return its output
     (≙ horovod_torch_wait_and_clear + synchronize, torch/mpi_ops.py:328-344).
@@ -2706,51 +2757,7 @@ def synchronize(handle: int):
     h = st.handle_manager._get(handle)
     if h.result is None:
         if st.multiprocess:
-            # Completion depends on the other processes: wait (with the
-            # background tick also draining) up to a timeout.
-            import os as _os
-            import time as _time
-
-            timeout = float(_os.environ.get("HOROVOD_TPU_SYNC_TIMEOUT",
-                                            "300"))
-            deadline = _time.monotonic() + timeout
-            while h.result is None and _time.monotonic() < deadline:
-                _drain()
-                _time.sleep(0.001)
-            if h.result is None:
-                # Withdraw GROUP-WIDE (round 4): tell the coordinator we
-                # gave up so it broadcasts an ERROR response and every
-                # rank fails this op within the grace window — instead of
-                # each peer serially eating its own full timeout, or (the
-                # SPMD hazard) this rank later skipping a broadcast
-                # response its peers execute and block on.
-                try:
-                    w_ps = _queue.peek_ps(h.name)
-                    if st.process_index == 0:
-                        coord = (st.coordinator if w_ps is None
-                                 else w_ps.coordinator)
-                        coord.withdraw(h.name, 0)
-                    else:
-                        st.transport.withdraw(
-                            h.name,
-                            0 if w_ps is None else w_ps.process_set_id)
-                except (OSError, AttributeError):
-                    pass  # controller unreachable: fall back to local
-                grace_dl = _time.monotonic() + float(_os.environ.get(
-                    "HOROVOD_TPU_WITHDRAW_GRACE", "10"))
-                while h.result is None and _time.monotonic() < grace_dl:
-                    _drain()
-                    _time.sleep(0.001)
-            if h.result is None:
-                # Controller never answered the withdrawal: error locally
-                # so the name can be reused and the handle doesn't pin
-                # the contribution forever.
-                _queue.take([h.name])
-                h.result = HorovodError(
-                    f"Collective {h.name} timed out after {timeout:.0f}s "
-                    f"waiting for the remaining processes (see the "
-                    f"coordinator's stall warnings for which ranks are "
-                    f"missing).")
+            _wait_mp_result(st, h)
         else:
             _drain()
             h = st.handle_manager._get(handle)
@@ -2779,16 +2786,21 @@ def take_async(handle: int):
     the in-flight ``jax.Array`` future; XLA's per-device program order
     guarantees the consumer reads it after the reduction wrote it.
 
-    Single-process only (the overlap path's mode); multi-process
-    callers get :func:`synchronize`'s full wait-with-withdraw
-    semantics.  Raises :class:`HorovodError` exactly like synchronize.
+    Multi-process callers keep :func:`synchronize`'s full
+    wait-with-withdraw semantics for the CONTROL plane (the response
+    must have been broadcast and executed locally — that depends on
+    the other processes) but skip the device-completion block, so an
+    overlapped mp step can feed each bucket's in-flight reduction
+    straight into the optimizer apply.  Raises :class:`HorovodError`
+    exactly like synchronize.
     """
     st = _state.global_state()
-    if st.multiprocess:
-        return synchronize(handle)
     h = st.handle_manager._get(handle)
     if h.result is None:
-        _drain()
+        if st.multiprocess:
+            _wait_mp_result(st, h)
+        else:
+            _drain()
     if h.result is None:
         raise HorovodError(
             f"Collective {h.name} cannot complete: not all replica requests "

@@ -748,6 +748,11 @@ _SPMD_ENV_KNOBS = (
     # bucketed sub-programs — so a rank diverging on it must be named
     # at startup exactly like the compression/topology knobs.
     "HVD_TPU_OVERLAP",
+    # MPMD pipeline schedule (parallel/pipeline.py): selects the
+    # dispatch ORDER of the per-stage executables (1f1b vs gpipe,
+    # interleave depth) — rank-divergent orders would desynchronize
+    # the per-stage partial-cycle negotiation.
+    "HVD_TPU_PIPELINE_SCHEDULE", "HVD_TPU_PIPELINE_INTERLEAVE",
     # Tree control-plane overlay (ops/tree.py): these select the wire
     # conversation itself (who connects to whom, which frames flow), so
     # a divergent rank would deadlock the handshake — name it at init.

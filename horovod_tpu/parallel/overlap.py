@@ -71,14 +71,34 @@ a rank runs, so it must be uniform fleet-wide):
       comparator; what a non-overlapped dynamic path would do).
       off: the pre-overlap monolithic static step, unchanged.
 
-Scope: single-process (single-controller SPMD — the repo's primary TPU
-mode).  Multi-process negotiation runs at process granularity with
-process-local contributions; bucketed mp streaming is future work, so
-mp builds fall back to the monolithic step.  Adasum, sparse
-(IndexedSlices) gradients and subset meshes also fall back — Adasum is
-whole-gradient by definition, sparse leaves ship a negotiated-size
-payload the bucket planner cannot size, and a sub-mesh step must keep
-its in-program reduction.
+Scope: single-process (single-controller SPMD) AND multi-process
+builds.  Multi-process negotiation runs at process granularity with
+process-local contributions, and the overlapped mp step rides exactly
+that contract: the forward/backward programs are the same global-mesh
+SPMD programs the single-process schedule compiles, and each bucket's
+fusion group is submitted as this process's LOCAL gradient rows —
+negotiated over the TCP control plane as a partial cycle (one
+coalesced request frame per bucket, atomic against the drain tick),
+replayed per-tensor from the response cache on the steady state, and
+executed by the mp megakernel (one donated reduce+unpack over the
+process mesh per bucket).  ``take_async`` waits for the broadcast
+response (control plane) but NOT for device completion, so the
+optimizer apply consumes in-flight reductions exactly like
+single-process.  The mp overlapped step is bitwise-identical to the
+monolithic mp step for the same reason the sp one is: same backward
+jaxprs, and the per-bucket psum over the process mesh reduces the
+same contributions the in-program psum reduces.
+
+Named fallbacks (each warns once, increments ``overlap.fallbacks``
+and flight-records an ``overlap_fallback`` event carrying the
+reason): ``adasum`` (whole-gradient by definition), ``sparse``
+(IndexedSlices leaves ship a negotiated-size payload the bucket
+planner cannot size), ``sub-mesh`` (a subset mesh must keep its
+in-program reduction), ``mp-local-replicas`` (a process holding >1
+local replica has no per-process contribution the mp data plane can
+carry), ``mp-mesh-order`` (process-mesh/global-mesh device order
+skew), ``grad-tree`` and ``nonstatic-compression``.  Plain
+multi-process mode is NOT a fallback anymore.
 """
 
 from __future__ import annotations
@@ -117,6 +137,10 @@ _VALID_MODES = ("auto", "on", "off", "serial")
 _M_BUCKETS = _telemetry.counter(
     "overlap.buckets_dispatched",
     "gradient buckets handed to the dynamic reduction path")
+_M_MP_BUCKETS = _telemetry.counter(
+    "overlap.mp_buckets_dispatched",
+    "gradient buckets negotiated as multi-process partial cycles "
+    "(subset of overlap.buckets_dispatched)")
 _M_FALLBACKS = _telemetry.counter(
     "overlap.fallbacks",
     "overlap-mode steps that fell back to the monolithic path")
@@ -354,6 +378,54 @@ class _InflightWindow:
 
 
 # ---------------------------------------------------------------------------
+# Partial-cycle dispatch (shared with parallel/pipeline.py)
+# ---------------------------------------------------------------------------
+
+def dispatch_bucket_segment(prefix: str, seg: _Segment, seg_leaves: List,
+                            handles: List[Optional[int]], tl,
+                            mp: bool = False) -> None:
+    """Hand one gradient segment's buckets to the dynamic path.
+    Submission is atomic against the background drain tick, and the
+    explicit drain right after dispatches each bucket's megakernel
+    immediately — before the next (earlier) backward segment.  The
+    1F1B pipeline schedule (parallel/pipeline.py) streams each stage's
+    buckets through this same choreography the moment that stage's
+    last microbatch backward is dispatched.
+
+    Multi-process: each leaf's contribution is this process's LOCAL
+    row of the per-replica gradient (``addressable_data(0)`` — a
+    zero-copy view of the shard this process computed; the
+    ``mp-local-replicas`` guard pinned one replica per process).  The
+    bucket's requests buffer under the drain lock and the drain
+    flushes them as ONE coalesced control frame — the partial cycle
+    the coordinator negotiates (and, on the steady state, the
+    response cache replays) independently of the other buckets still
+    inside the backward.  Inputs are not declared donated in mp: the
+    local rows share their buffers with the live global gradient
+    arrays, and the mp executor's local pack copies them into the
+    fusion buffer anyway."""
+    for b in seg.buckets:
+        tensors = [seg_leaves[p] for p in b.local_pos]
+        base = f"{prefix}.g{b.gi}"
+        if mp:
+            tensors = [t.addressable_data(0) for t in tensors]
+        with C._drain_lock:
+            hs = C.grouped_allreduce_async(
+                tensors, op=ReduceOp.SUM, name=base,
+                donate_inputs=not mp)
+        C._drain()
+        for idx, h in zip(b.global_idx, hs):
+            handles[idx] = h
+        _M_BUCKETS.inc()
+        if mp:
+            _M_MP_BUCKETS.inc()
+        if tl is not None:
+            tl.instant(base, "BUCKET_DISPATCH",
+                       args={"bucket": b.gi, "tensors": len(hs),
+                             "bytes": b.nbytes})
+
+
+# ---------------------------------------------------------------------------
 # The overlapped step
 # ---------------------------------------------------------------------------
 
@@ -366,7 +438,11 @@ def _next_prefix() -> str:
     identical across steps (the response-cache key) and unique across
     step builders in one process; construction order is part of the
     SPMD program and — like every compiled-program knob — must match
-    across ranks (moot today: multi-process builds fall back)."""
+    across ranks: a multi-process build's bucket names negotiate over
+    the control plane, so every rank must construct its overlapped
+    steps in the same order (user training scripts are SPMD, so they
+    do; a divergence is caught by the coordinator's name/shape
+    mismatch diagnostics on the first step)."""
     global _prefix_counter
     with _prefix_lock:
         _prefix_counter += 1
@@ -409,8 +485,10 @@ class _OverlapStep:
         self._cpu_mesh = _is_cpu_mesh(mesh)
         self._built = False
         self._fallback_step: Optional[Callable] = None
+        self._fallback_reason: Optional[str] = None
         self._plan: Optional[_Plan] = None
         self._segmented = False
+        self._mp = False
         self._treedef = None
         self._ctxs: Optional[list] = None  # per-leaf decompress contexts
 
@@ -432,10 +510,16 @@ class _OverlapStep:
         return None if self._plan is None else len(self._plan.segments)
 
     # -- fallback ----------------------------------------------------------
-    def _fall_back(self, reason: str):
-        print(f"[hvd-overlap] falling back to the monolithic step: "
-              f"{reason}", file=sys.stderr)
+    def _fall_back(self, reason: str, detail: str):
+        """Build the monolithic step instead, leaving the standard
+        triple-entry record — one warn line, one ``overlap.fallbacks``
+        counter tick and one ``overlap_fallback`` flight event, all
+        carrying the NAMED reason (tests assert the lockstep)."""
+        print(f"[hvd-overlap] falling back to the monolithic step "
+              f"[{reason}]: {detail}", file=sys.stderr)
         _M_FALLBACKS.inc()
+        _telemetry.overlap_fallback_event(reason, detail)
+        self._fallback_reason = reason
         self._fallback_step = self._fallback_builder()
         return self._fallback_step
 
@@ -446,12 +530,21 @@ class _OverlapStep:
         coordinator's fusion planner packs replayed cycles with ITS
         threshold, so a bucket must never exceed it (it would split
         into two launches and, under quantized formats, re-partition
-        the scaling blocks)."""
+        the scaling blocks).  Multi-process builds use the state's
+        threshold instead: the live coordinator value is rank-0-only
+        knowledge, while ``st.fusion_threshold_bytes`` starts from the
+        (env-fingerprinted) HOROVOD_FUSION_THRESHOLD and is updated by
+        the same fleet-wide hook that retunes the coordinators — the
+        bucket partition must be identical on every rank (it is the
+        collective program)."""
         st = _state.global_state()
-        try:
-            coord = int(st.coordinator.fusion_threshold)
-        except Exception:  # noqa: BLE001 — no coordinator (size checks)
-            coord = _fusion_threshold_bytes()
+        if st.multiprocess:
+            coord = int(st.fusion_threshold_bytes)
+        else:
+            try:
+                coord = int(st.coordinator.fusion_threshold)
+            except Exception:  # noqa: BLE001 — no coordinator (size checks)
+                coord = _fusion_threshold_bytes()
         if self._fusion_threshold is None:
             return coord
         return min(int(self._fusion_threshold), coord)
@@ -480,16 +573,46 @@ class _OverlapStep:
     def _build(self, args) -> None:
         self._built = True
         st = _state.global_state()
-        if st.multiprocess:
+        if self._red_op == ReduceOp.ADASUM:
+            # Adasum never overlaps: its scale-insensitive combination
+            # is defined on the WHOLE gradient vector — there is no
+            # per-bucket decomposition to stream.
             self._fall_back(
-                "multi-process mode negotiates process-local "
-                "contributions; bucketed mp streaming is future work")
+                "adasum",
+                "op=Adasum combines the whole gradient vector; no "
+                "per-bucket decomposition exists")
             return
         if tuple(self._mesh.devices.flat) != tuple(st.devices):
             self._fall_back(
+                "sub-mesh",
                 "step mesh is not the global replica mesh; a subset "
                 "mesh keeps its in-program reduction")
             return
+        self._mp = bool(st.multiprocess)
+        if self._mp:
+            if st.size != st.process_count:
+                # The mp data plane carries exactly ONE contribution
+                # per process (ops/collective._mp_global); a process
+                # holding several local replicas would need a local
+                # pre-reduction the bitwise contract cannot absorb.
+                self._fall_back(
+                    "mp-local-replicas",
+                    f"{st.size} replicas over {st.process_count} "
+                    f"processes; the mp data plane reduces one "
+                    f"contribution per process")
+                return
+            mp_mesh = C._mp_kernels()[0]
+            if tuple(mp_mesh.devices.flat) != tuple(
+                    self._mesh.devices.flat):
+                # The reduced buckets come back committed to the
+                # process mesh; the apply program runs over the global
+                # mesh — they must agree on device order or XLA
+                # rejects the mixed device assignment.
+                self._fall_back(
+                    "mp-mesh-order",
+                    "process-mesh device order differs from the "
+                    "global replica mesh")
+                return
         if self._has_state:
             params, model_state, _opt_state, batch = args
         else:
@@ -504,10 +627,11 @@ class _OverlapStep:
             else:
                 self._build_unsegmented(params, model_state, batch)
         except _Unbucketable as e:
-            self._fall_back(str(e))
+            self._fall_back(e.reason, str(e))
             return
         except _NonStaticContext:
             self._fall_back(
+                "nonstatic-compression",
                 "compression context is value-dependent; the decompress "
                 "cannot move to a separate apply program")
             return
@@ -564,10 +688,12 @@ class _OverlapStep:
             grads, is_leaf=lambda g: isinstance(g, IndexedSlices))
         if any(isinstance(g, IndexedSlices) for g in flat):
             raise _Unbucketable(
+                "sparse",
                 "sparse (IndexedSlices) gradient leaves ship a "
                 "negotiated-size payload the bucket planner cannot size")
         if tdef != jax.tree_util.tree_structure(params):
             raise _Unbucketable(
+                "grad-tree",
                 "gradient tree structure differs from the params tree")
 
     def _build_segmented(self, params, batch) -> None:
@@ -675,33 +801,25 @@ class _OverlapStep:
             return optax.apply_updates(params, updates), opt_state
 
         donate = (0, 1, 2) if self._donate else (0,)
+        # Single-process: reduced buckets are per-replica [size, ...]
+        # arrays — each replica squeezes its own row.  Multi-process:
+        # the mp megakernel returns REPLICATED [1, ...] tensors (the
+        # negotiated local-row shape), so the grads ride in replicated
+        # and every replica squeezes the same row; the psum(ones)
+        # denominator still counts the world replicas (== processes —
+        # the mp-local-replicas guard pinned size == process_count),
+        # which is exactly the mp AVERAGE denominator.
+        grads_spec = P() if self._mp else P(REPLICA_AXIS)
         return jax.jit(_compat.shard_map(
             apply_body, mesh=self._mesh,
-            in_specs=(P(REPLICA_AXIS), P(), P()), out_specs=(P(), P()),
+            in_specs=(grads_spec, P(), P()), out_specs=(P(), P()),
             check_vma=False), donate_argnums=donate)
 
     # -- execution ---------------------------------------------------------
     def _submit_segment(self, seg: _Segment, seg_leaves: List,
                         handles: List[Optional[int]], tl) -> None:
-        """Hand one backward segment's buckets to the dynamic path.
-        Submission is atomic against the background drain tick, and the
-        explicit drain right after dispatches each bucket's megakernel
-        immediately — before the next (earlier) backward segment."""
-        for b in seg.buckets:
-            tensors = [seg_leaves[p] for p in b.local_pos]
-            base = f"{self._prefix}.g{b.gi}"
-            with C._drain_lock:
-                hs = C.grouped_allreduce_async(
-                    tensors, op=ReduceOp.SUM, name=base,
-                    donate_inputs=True)
-            C._drain()
-            for idx, h in zip(b.global_idx, hs):
-                handles[idx] = h
-            _M_BUCKETS.inc()
-            if tl is not None:
-                tl.instant(base, "BUCKET_DISPATCH",
-                           args={"bucket": b.gi, "tensors": len(hs),
-                                 "bytes": b.nbytes})
+        dispatch_bucket_segment(self._prefix, seg, seg_leaves, handles,
+                                tl, mp=self._mp)
 
     def __call__(self, *args):
         if self._fallback_step is not None:
@@ -802,7 +920,13 @@ class _OverlapStep:
 
 class _Unbucketable(Exception):
     """Raised during plan building when the gradient tree cannot take
-    the bucketed path; the step falls back to the monolithic program."""
+    the bucketed path; the step falls back to the monolithic program.
+    ``reason`` is the short fallback name the telemetry/flight record
+    carries (``sparse``, ``grad-tree``)."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(detail)
+        self.reason = reason
 
 
 class _NonStaticContext(Exception):
@@ -817,7 +941,7 @@ def make_overlapped_step(loss_fn, optimizer, mesh, red_op: ReduceOp,
     """Build the bucketed-backward step (``parallel/training._make_step``
     calls this when the overlap mode resolves on).  ``fallback_builder``
     constructs the monolithic static step for the unbucketable cases
-    (sparse leaves, subset meshes, multi-process mode)."""
+    (Adasum, sparse leaves, subset meshes)."""
     if optax is None:
         return fallback_builder()
     return _OverlapStep(loss_fn, optimizer, mesh, red_op,

@@ -226,14 +226,14 @@ def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,
         compression = optimizer._compression
         optimizer = optimizer._inner
 
-    from ..ops.wire import ReduceOp
-
     schedule = _overlap.resolve_mode(overlap, mesh)
     red_op = _resolve_grad_op(average, op)
-    # Adasum never overlaps: its scale-insensitive combination is
-    # defined on the WHOLE gradient vector — there is no per-bucket
-    # decomposition to stream (see allreduce_gradients).
-    if schedule != "off" and red_op != ReduceOp.ADASUM:
+    # Adasum never overlaps (its scale-insensitive combination is
+    # defined on the WHOLE gradient vector) — but the overlap builder
+    # owns that decision now, so the fallback is warned, counted
+    # (overlap.fallbacks) and flight-recorded under its name like
+    # every other unbucketable case.
+    if schedule != "off":
         inner_optimizer = optimizer
 
         def fallback_builder():

@@ -204,6 +204,17 @@ def checkpoint_error_event(path: str, detail: str) -> None:
         _M_DUMPS.inc()
 
 
+def overlap_fallback_event(reason: str, detail: str) -> None:
+    """The backward/communication-overlap step fell back to the
+    monolithic program (parallel/overlap.py): flight-record the NAMED
+    reason (``adasum``/``sparse``/``sub-mesh``/...) with its
+    human-readable detail.  The ``overlap.fallbacks`` counter is
+    incremented by the caller in lockstep — one counter tick, one
+    flight event, one warn line per fallback.  Recorded but NOT
+    dumped: a fallback is a degraded mode, not a failure."""
+    flight.record("overlap_fallback", reason, detail)
+
+
 def install_runtime_collector() -> None:
     """Register the pull-side collector over the runtime's existing
     cheap stats structs (CacheStats, MegakernelStats, the handle pool).

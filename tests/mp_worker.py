@@ -350,6 +350,150 @@ def scenario_spmd_train(hvd):
     print(f"SPMD_OK rank={rank} loss={final:.6f}")
 
 
+def scenario_overlap(hvd):
+    """Multi-process bucketed streaming (ISSUE 12 tentpole a): the
+    overlapped np=2 train step — per-bucket partial cycles negotiated
+    over the REAL TCP control plane, mp megakernel reductions,
+    take_async feeding in-flight results into the apply — is
+    BITWISE-identical to the monolithic mp step, for both the plain
+    (single-backward) and the ChainedLoss (segmented) schedule; on the
+    steady state every bucket replays from the response cache with
+    ZERO new negotiation misses."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu.telemetry as _tel
+    from horovod_tpu.core import state as _st
+    from horovod_tpu.parallel.overlap import ChainedLoss
+    from horovod_tpu.parallel.training import (make_train_step,
+                                               shard_local_batch)
+
+    rank, size = hvd.rank(), hvd.size()
+    D = 16
+
+    def stage0(p, carry, b):
+        x, _y = b
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def stage1(p, carry, b):
+        _x, y = b
+        pred = carry @ p["w"] + p["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    chain = ChainedLoss([stage0, stage1])
+
+    def plain_loss(p, b):
+        return chain(p, b)
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 2)
+    params0 = [{"w": jax.random.normal(k, (D, D)) * D ** -0.5,
+                "b": jnp.zeros((D,))} for k in ks]
+    X = np.asarray(jax.random.normal(jax.random.PRNGKey(1), (8 * size, D)),
+                   dtype="float32")
+    Y = np.asarray(jax.random.normal(jax.random.PRNGKey(2), (8 * size, D)),
+                   dtype="float32")
+    lo = rank * (len(X) // size)
+    batch = shard_local_batch((X[lo:lo + len(X) // size],
+                               Y[lo:lo + len(Y) // size]))
+    opt = optax.adam(1e-3)
+    threshold = D * D * 4  # w and b bucket apart per stage
+
+    def run(step, steps=4):
+        p, s = params0, opt.init(params0)
+        loss = None
+        for _ in range(steps):
+            p, s, loss = step(p, s, batch)
+        jax.block_until_ready(jax.tree_util.tree_leaves(p))
+        return p, float(loss)
+
+    def leaves_equal(a, b):
+        return all(
+            np.asarray(u).tobytes() == np.asarray(v).tobytes()
+            for u, v in zip(jax.tree_util.tree_leaves(a),
+                            jax.tree_util.tree_leaves(b)))
+
+    fallbacks0 = _tel.metrics().get(
+        "overlap.fallbacks", {}).get("value", 0)
+
+    # Leg 1 — segmented schedule (ChainedLoss): streamed mp partial
+    # cycles ≡ the monolithic mp step, bitwise after 4 adam steps.
+    step_on = make_train_step(chain, opt, donate=False,
+                              fusion_threshold=threshold, overlap="on")
+    p_on, l_on = run(step_on)
+    assert step_on.overlap_active, "mp build fell back"
+    assert step_on.segment_count == 2
+    assert step_on.bucket_count == 4
+    step_off = make_train_step(chain, opt, donate=False,
+                               fusion_threshold=threshold, overlap="off")
+    p_off, l_off = run(step_off)
+    assert l_on == l_off, (l_on, l_off)
+    assert leaves_equal(p_on, p_off), "overlapped mp != monolithic mp"
+    print(f"OVERLAP_SEG_OK rank={rank} loss={l_on:.6f}")
+
+    # Leg 2 — plain loss (single-backward streaming): same contract.
+    step_u_on = make_train_step(plain_loss, opt, donate=False,
+                                fusion_threshold=threshold, overlap="on")
+    p_u_on, _ = run(step_u_on, 2)
+    assert step_u_on.overlap_active
+    step_u_off = make_train_step(plain_loss, opt, donate=False,
+                                 fusion_threshold=threshold,
+                                 overlap="off")
+    p_u_off, _ = run(step_u_off, 2)
+    assert leaves_equal(p_u_on, p_u_off)
+    print(f"OVERLAP_PLAIN_OK rank={rank}")
+
+    # Leg 3 — steady state: every bucket's partial cycle replays from
+    # the response cache; two further steps add ZERO negotiation
+    # misses on either rank, and the mp bucket counter advances.
+    st = _st.global_state()
+    cache = st.response_cache
+    assert cache is not None
+    misses0 = cache.stats.misses
+    mp0 = _tel.metrics().get(
+        "overlap.mp_buckets_dispatched", {}).get("value", 0)
+    p, s = p_on, opt.init(p_on)
+    for _ in range(2):
+        p, s, _loss = step_on(p, s, batch)
+    jax.block_until_ready(jax.tree_util.tree_leaves(p))
+    assert cache.stats.misses == misses0, (
+        f"steady-state mp buckets renegotiated: "
+        f"{cache.stats.misses - misses0} new misses")
+    mp_buckets = _tel.metrics()[
+        "overlap.mp_buckets_dispatched"]["value"] - mp0
+    assert mp_buckets == 2 * step_on.bucket_count, mp_buckets
+    fallbacks = _tel.metrics().get(
+        "overlap.fallbacks", {}).get("value", 0) - fallbacks0
+    assert fallbacks == 0, f"{fallbacks} unexpected overlap fallbacks"
+
+    # Leg 4 — transport fault MID-PARTIAL-CYCLE: rank 1's control-plane
+    # socket is hard-reset right before a training step, so the very
+    # next bucket's coalesced request frame hits the dead socket
+    # mid-flush; the session-resume protocol replays the lost frames
+    # (cache replicas stay index-aligned) and the trained parameters
+    # stay BITWISE-identical to the uninterrupted monolithic run — the
+    # no-new-hang-class contract for partial cycles.
+    p, s = params0, opt.init(params0)
+    for stepi in range(6):
+        if stepi == 3 and rank == 1:
+            from horovod_tpu.ops import transport as _tp
+
+            _tp._hard_close(st.transport._sock)
+        p, s, _loss = step_on(p, s, batch)
+    jax.block_until_ready(jax.tree_util.tree_leaves(p))
+    q, t = params0, opt.init(params0)
+    for _ in range(6):
+        q, t, _loss = step_off(q, t, batch)
+    jax.block_until_ready(jax.tree_util.tree_leaves(q))
+    assert leaves_equal(p, q), \
+        "post-reconnect overlapped params != uninterrupted monolithic"
+    if rank == 1:
+        got = _tel.metrics().get("transport.reconnects",
+                                 {}).get("value", 0)
+        assert got >= 1, f"no reconnect was recorded: {got}"
+    print(f"OVERLAP_OK rank={rank} buckets={mp_buckets}")
+
+
 def scenario_chaos(hvd):
     """hvd-chaos acceptance (ISSUE 9): a worker's control-plane
     connection dies mid-training; the worker reconnects with backoff,

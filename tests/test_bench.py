@@ -240,11 +240,54 @@ def test_bench_overlap_mode_contract_and_identity():
     assert payload["segmented_close"] is True, payload
     assert payload["int8"]["bitwise_identical"] is True, payload
     assert payload["int8"]["quantized_active"] is True, payload
+    # The np=2 mp leg rides the JSON; 'unavailable' is legitimate on a
+    # jax without np>1 CPU collectives (this container), 'failed' is a
+    # real regression.
+    assert payload["mp"]["status"] in ("ok", "unavailable", "skipped"), \
+        payload["mp"]
     # The transformer chain really segmented and streamed per bucket.
     assert payload["segments"] > 1 and payload["buckets"] > payload["segments"]
     tel = payload["telemetry"]
     assert tel["buckets_dispatched"] and tel["buckets_dispatched"] > 0
     assert tel["fallbacks"] == 0, payload
+
+
+def test_bench_pipeline_mode_contract_and_identity():
+    """`--mode pipeline` (this round): the 1F1B MPMD pipeline-schedule
+    microbench emits one contract JSON line and must clear the
+    deterministic gates — 1f1b params/loss bitwise ≡ the GPipe-ordered
+    dispatch of the same per-stage executables, allclose vs the
+    monolithic microbatch-mean gradient, and the exposed-bubble
+    seconds strictly below the gpipe leg (the gpipe leg pays fence +
+    serialized dispatch + reduction inside the measured window, so the
+    ordering survives a loaded box).  The steps/sec floor lives in the
+    CI `pipeline-bench` job."""
+    env = dict(os.environ)
+    env["HVD_TPU_BENCH_PIPELINE_QUICK"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "pipeline"],
+        env=env, cwd=REPO, capture_output=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    lines = [ln for ln in proc.stdout.decode().splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1, proc.stdout.decode()
+    payload = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline",
+                "schedule_1f1b", "schedule_gpipe", "speedup",
+                "bitwise_identical", "reference_close",
+                "exposed_bubble_seconds_per_step", "bubble_hidden",
+                "plan", "buckets"):
+        assert key in payload, payload
+    assert payload["metric"] == "pipeline_steps_per_sec"
+    assert payload["schedule_1f1b"] > 0 and payload["schedule_gpipe"] > 0
+    assert payload["bitwise_identical"] is True, payload
+    assert payload["reference_close"] is True, payload
+    assert payload["bubble_hidden"] is True, payload
+    plan = payload["plan"]
+    # 1F1B's memory bound: peak in-flight activations below GPipe's.
+    assert plan["peak_activations_1f1b"] < plan["peak_activations_gpipe"]
+    assert payload["buckets"] >= plan["n_stages"]
 
 
 @pytest.mark.slow
@@ -264,6 +307,10 @@ def test_bench_failure_still_emits_contract_json():
     payload = json.loads(lines[-1])
     assert payload["value"] is None
     assert "error" in payload
+    # The CPU-only microbench sections ride the failure JSON too —
+    # a dead tunnel can zero none of them (incl. this round's
+    # pipeline section).
+    assert "pipeline" in payload and "overlap" in payload, payload
     # The probe must have retried (>1 probe event) before giving up.
     probe_events = [e for e in payload["attempt_log"]
                     if e["event"] == "probe_fail"]

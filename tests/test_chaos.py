@@ -673,8 +673,16 @@ def test_matrix_digest_and_result_parsing():
     d2 = matrix._digest([(0, "b"), (1, "a")])
     assert d1 == d2  # order-insensitive
     assert d1 != matrix._digest([(0, "b")])
-    out = "noise\nCHAOS_RESULT rank=1 n=3 digest=abc\nmore"
-    assert matrix._parse_results(out) == {1: "n=3 digest=abc"}
+    dg = matrix._digest([(0, "b")])  # the real 24-hex shape
+    out = f"noise\nCHAOS_RESULT rank=1 n=3 digest={dg}\nmore"
+    assert matrix._parse_results(out) == {1: f"n=3 digest={dg}"}
+    # Interleaved-writer hardening: a log fragment glued onto the
+    # digest token (observed: "[hvd-tree]" under tier-1 load) or
+    # prefixed to the line must not corrupt the parse.
+    out = (f"CHAOS_RESULT rank=0 n=3 digest={dg}[hvd-tree] adopting\n"
+           f"[hvd-chaos] x CHAOS_RESULT rank=1 n=3 digest={dg}")
+    assert matrix._parse_results(out) == {0: f"n=3 digest={dg}",
+                                          1: f"n=3 digest={dg}"}
 
 
 def test_matrix_smoke_one_cp_scenario():

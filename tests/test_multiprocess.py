@@ -320,6 +320,24 @@ def test_chaos_reconnect_mid_training_bitwise(tmp_path):
 
 
 @pytest.mark.slow
+def test_overlap_mp_bucketed_streaming_bitwise():
+    """Multi-process bucketed streaming (ISSUE 12 tentpole a): the
+    np=2 overlapped step — per-bucket partial cycles over the REAL
+    control plane, mp megakernels, take_async apply — is
+    bitwise-identical to the monolithic mp step (segmented AND plain
+    schedules), and the steady state replays every bucket from the
+    response cache with zero new negotiation misses (asserted inside
+    tests/mp_worker.py scenario_overlap).  Like every mp data-plane
+    leg this needs a jax with np>1 CPU collectives (CI's jax; the
+    container's 0.4.37 cannot)."""
+    out = _launch("overlap", timeout=300.0)
+    for rank in (0, 1):
+        assert f"OVERLAP_SEG_OK rank={rank}" in out, out
+        assert f"OVERLAP_PLAIN_OK rank={rank}" in out, out
+        assert f"OVERLAP_OK rank={rank}" in out, out
+
+
+@pytest.mark.slow
 def test_response_cache_two_processes():
     """Steady-state negotiation bypass across REAL processes
     (ops/cache.py): coalesced bit-vector request frames, compact replay

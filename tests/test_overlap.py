@@ -356,8 +356,32 @@ def test_fusion_threshold_change_replans_buckets(hvd):
 
 
 # ---------------------------------------------------------------------------
-# Fallbacks: unbucketable trees keep the monolithic program
+# Fallbacks: unbucketable trees keep the monolithic program, and every
+# fallback leaves the triple-entry record — ONE overlap.fallbacks
+# counter tick and ONE overlap_fallback flight event, carrying the
+# NAMED reason (the warn line rides stderr).
 # ---------------------------------------------------------------------------
+
+def _fallback_events():
+    from horovod_tpu.telemetry import flight
+
+    return [e for e in flight.snapshot() if e[1] == "overlap_fallback"]
+
+
+def _fallbacks_counter():
+    import horovod_tpu as H
+
+    return H.metrics().get("overlap.fallbacks", {}).get("value", 0)
+
+
+def _assert_fell_back_once(step, reason, counter0, events0):
+    assert step.overlap_active is False
+    assert step._fallback_reason == reason
+    assert _fallbacks_counter() - counter0 == 1
+    new = _fallback_events()[events0:]
+    assert len(new) == 1, new
+    assert new[0][2][0] == reason, new
+
 
 def test_sparse_gradient_leaves_fall_back(hvd):
     """IndexedSlices gradient leaves ship a negotiated-size payload the
@@ -372,32 +396,54 @@ def test_sparse_gradient_leaves_fall_back(hvd):
                                     (_DIM, _DIM))
         return jnp.zeros(()), grads
 
-    with pytest.raises(OV._Unbucketable, match="sparse"):
+    with pytest.raises(OV._Unbucketable, match="sparse") as ei:
         step._detect_sparse(sparse_grad_fn,
                             _plain_params(jax.random.PRNGKey(0)), None,
                             _batch(hvd, jax.random.PRNGKey(1)))
+    assert ei.value.reason == "sparse"
 
 
-def test_adasum_never_overlaps(hvd):
+def test_sparse_fallback_counts_and_flight_records_once(hvd, monkeypatch):
+    """The sparse refusal surfaces through the step as the named
+    ``sparse`` fallback: counter and flight event exactly once."""
+    opt = optax.sgd(0.1)
+    step = make_train_step(_plain_loss, opt, donate=False, overlap="on")
+    monkeypatch.setattr(
+        OV._OverlapStep, "_detect_sparse",
+        lambda self, *a: (_ for _ in ()).throw(OV._Unbucketable(
+            "sparse", "seeded sparse leaf")))
+    c0, e0 = _fallbacks_counter(), len(_fallback_events())
+    params = _plain_params(jax.random.PRNGKey(0))
+    batch = _batch(hvd, jax.random.PRNGKey(1))
+    p, s, _loss = step(params, opt.init(params), batch)
+    step(p, s, batch)  # second step: no new record
+    _assert_fell_back_once(step, "sparse", c0, e0)
+
+
+def test_adasum_fallback_counts_and_flight_records_once(hvd):
     """op=Adasum combines the WHOLE gradient vector — no per-bucket
-    decomposition exists, so the builder keeps the static step even
-    with overlap forced on."""
+    decomposition exists, so the first call falls back to the static
+    step under the named ``adasum`` reason (counted + flight-recorded
+    exactly once, further steps free)."""
     import horovod_tpu as H
 
     opt = optax.sgd(0.1)
     step = make_train_step(_plain_loss, opt, donate=False, op=H.Adasum,
                            overlap="on")
-    assert not hasattr(step, "overlap_active")
+    c0, e0 = _fallbacks_counter(), len(_fallback_events())
     params = _plain_params(jax.random.PRNGKey(0))
-    p, _, loss = step(params, opt.init(params),
-                      _batch(hvd, jax.random.PRNGKey(1)))
+    batch = _batch(hvd, jax.random.PRNGKey(1))
+    p, s, loss = step(params, opt.init(params), batch)
+    p, s, loss = step(p, s, batch)  # second step: no new record
     assert np.isfinite(float(loss))
+    _assert_fell_back_once(step, "adasum", c0, e0)
 
 
 def test_subset_mesh_falls_back(hvd):
     """A step built over a sub-mesh of the global replica set keeps its
     in-program reduction (the dynamic path negotiates over ALL
-    replicas); results match the monolithic sub-mesh step bitwise."""
+    replicas); results match the monolithic sub-mesh step bitwise, and
+    the fallback records once under the named ``sub-mesh`` reason."""
     devices = jax.devices()[:4]
     mesh = jax.sharding.Mesh(np.asarray(devices), (REPLICA_AXIS,))
     params = _plain_params(jax.random.PRNGKey(0))
@@ -406,12 +452,44 @@ def test_subset_mesh_falls_back(hvd):
     opt = optax.sgd(0.1)
     step = make_train_step(_plain_loss, opt, mesh=mesh, donate=False,
                            overlap="on")
+    c0, e0 = _fallbacks_counter(), len(_fallback_events())
     p_on, _ = _run(step, params, opt, (x, y), 2)
-    assert step.overlap_active is False  # fell back on first call
+    _assert_fell_back_once(step, "sub-mesh", c0, e0)
     step_off = make_train_step(_plain_loss, opt, mesh=mesh, donate=False,
                                overlap="off")
     p_off, _ = _run(step_off, params, opt, (x, y), 2)
     assert _leaves_equal(p_on, p_off)
+
+
+def test_mp_is_not_a_fallback_anymore(hvd, monkeypatch):
+    """After this PR a plain multi-process build (one replica per
+    process, aligned meshes) passes the build gates and proceeds to
+    the bucketed path — asserted by faking the mp state flags and
+    watching the build reach plan construction instead of falling
+    back with an ``mp`` reason.  (The real np=2 bitwise leg rides
+    tests/mp_worker.py scenario_overlap under CI's jax.)"""
+    import horovod_tpu.ops.collective as C
+    import horovod_tpu.core.state as state_mod
+
+    st = state_mod.global_state()
+    monkeypatch.setattr(st, "multiprocess", True)
+    monkeypatch.setattr(st, "process_count", st.size)
+    monkeypatch.setattr(C, "_mp_kernels",
+                        lambda: (st.mesh, None))
+    opt = optax.sgd(0.1)
+    step = make_train_step(_plain_loss, opt, donate=False, overlap="on")
+    reached = {}
+
+    def probe(self, *a):
+        reached["build"] = True
+        raise OV._Unbucketable("grad-tree", "stop before any transport")
+
+    monkeypatch.setattr(OV._OverlapStep, "_build_unsegmented", probe)
+    c0, e0 = _fallbacks_counter(), len(_fallback_events())
+    params = _plain_params(jax.random.PRNGKey(0))
+    step(params, opt.init(params), _batch(hvd, jax.random.PRNGKey(1)))
+    assert reached.get("build"), "mp build gate still falls back"
+    _assert_fell_back_once(step, "grad-tree", c0, e0)
 
 
 # ---------------------------------------------------------------------------
