@@ -628,6 +628,29 @@ def _dataplane_bench(tensors: int = 32, elems: int = 256,
             }
         hvd.set_compression()  # restore the (pinned-none) env default
 
+        # hvd-mem: measured ledger high-watermark of one steady-state
+        # fused cycle vs the static planner's prediction (the ±15 %
+        # accuracy contract of docs/memory.md; --mode memory owns the
+        # CI gate, this section records the figures per round).  Same
+        # split-race retry policy as the dispatch-count contract.
+        from horovod_tpu.memory import ledger as _mem_ledger
+        from horovod_tpu.memory import planner as _mem_planner
+
+        led = _mem_ledger.ledger
+        for attempt in range(8):
+            led.reset()
+            launches0 = mk.stats.launches
+            cycle(f"memsec.{attempt}")
+            if mk.stats.launches - launches0 == 1:
+                break
+        mem_measured = led.watermark()
+        mem_predicted = _mem_planner.plan_dataplane(
+            tensors, elems, n).framework_bytes
+        mem_err_pct = (round(abs(mem_predicted - mem_measured)
+                             / mem_measured * 100.0, 2)
+                       if mem_measured else None)
+        led.reset()
+
         # Telemetry overhead A/B on the megakernel leg (same contract
         # as --mode control: the hvd-telemetry acceptance gate rides
         # the bench JSON).  The executor instrumentation is per
@@ -684,6 +707,21 @@ def _dataplane_bench(tensors: int = 32, elems: int = 256,
             "bitwise_identical": identical,
             "hierarchical_equal": hier_equal,
             "compression": compression_section,
+            # hvd-mem (docs/memory.md): the ledger's measured peak vs
+            # the planner's prediction, plus the ledger's share of the
+            # telemetry on/off overhead (the accounting sites gate on
+            # telemetry.enabled(), so tel_pct measures them too — the
+            # ≤5 % acceptance rides the same A/B).
+            "memory": {
+                "ledger_peak_bytes": mem_measured,
+                "planner_predicted_bytes": mem_predicted,
+                "prediction_error_pct": mem_err_pct,
+                "prediction_ok": mem_err_pct is not None
+                and mem_err_pct <= 15.0,
+                "ledger_overhead_pct": tel_pct,
+                "ledger_overhead_ok": tel_pct is not None
+                and tel_pct <= 5.0,
+            },
             "tensors": tensors,
             "elems": elems,
             "replicas": n,
@@ -1290,6 +1328,46 @@ def _pipeline_bench(steps: int = 8, warmup: int = 2) -> dict:
         f_rate, g_rate = median(rates["1f1b"]), median(rates["gpipe"])
         f_exp, g_exp = median(exposed["1f1b"]), median(exposed["gpipe"])
 
+        # hvd-mem: per-schedule measured activation peak (the ledger's
+        # pipeline.activations category) vs the planner's prediction
+        # (schedule_plan peak carries x carry bytes) — bytes, not
+        # tensor counts — plus a telemetry-on/off steps/sec A/B (the
+        # ledger accounting rides telemetry.enabled()).
+        from horovod_tpu import telemetry as _telemetry
+        from horovod_tpu.memory import ledger as _mem_ledger
+        from horovod_tpu.memory import planner as _mem_planner
+
+        led = _mem_ledger.ledger
+        memory_section = {}
+        for mode, stepx in (("1f1b", step_f), ("gpipe", step_g)):
+            led.reset()
+            stepx(params0, opt.init(params0), batch)
+            measured = led.peak_by_category().get(
+                "pipeline.activations", 0)
+            predicted = _mem_planner.pipeline_activation_bytes(
+                S, m, microbatch_rows=B // m, width=d, schedule=mode)
+            err = (round(abs(predicted - measured) / measured * 100.0,
+                         2) if measured else None)
+            memory_section[mode] = {
+                "ledger_peak_bytes": measured,
+                "planner_predicted_bytes": predicted,
+                "prediction_error_pct": err,
+                "prediction_ok": err is not None and err <= 15.0,
+            }
+        led.reset()
+        was_enabled = _telemetry.enabled()
+        _telemetry.set_enabled(False)
+        try:
+            _, _, dt_off = run(step_f, max(2, steps // 2), wu=1)
+        finally:
+            _telemetry.set_enabled(was_enabled)
+        _, _, dt_on = run(step_f, max(2, steps // 2), wu=1)
+        mem_overhead = (round((dt_on / dt_off - 1.0) * 100.0, 2)
+                        if dt_off else None)
+        memory_section["ledger_overhead_pct"] = mem_overhead
+        memory_section["ledger_overhead_ok"] = (
+            mem_overhead is not None and mem_overhead <= 5.0)
+
         plan_f, plan_g = step_f.plan, step_g.plan
         snap = hvd.metrics()
         return {
@@ -1316,6 +1394,7 @@ def _pipeline_bench(steps: int = 8, warmup: int = 2) -> dict:
             "buckets": step_f.bucket_count,
             "steps": steps,
             "replicas": n,
+            "memory": memory_section,
             "telemetry": {
                 "microbatches": snap.get(
                     "pipeline.microbatches", {}).get("value"),
@@ -1323,7 +1402,206 @@ def _pipeline_bench(steps: int = 8, warmup: int = 2) -> dict:
                     "pipeline.bubble_seconds", {}).get("sum", 0.0), 4),
                 "inflight_activations": snap.get(
                     "pipeline.inflight_activations", {}).get("value"),
+                "inflight_activation_bytes": snap.get(
+                    "pipeline.inflight_activation_bytes",
+                    {}).get("value"),
             },
+        }
+    finally:
+        hvd.shutdown()
+
+
+def _memory_bench(tensors: int = 16, elems: int = 256,
+                  cycles: int = 20) -> dict:
+    """hvd-mem microbench (``--mode memory``): the planner-vs-ledger
+    accuracy contract plus plan determinism and the seeded-OOM
+    forensics path, CPU-only like ``--mode control``.
+
+    Four gates ride the JSON (CI job ``memory``, ``--check-memory-plan``):
+
+    * ``plan_deterministic`` — identical configs produce byte-identical
+      plan JSON (CLI determinism);
+    * ``dataplane.prediction_error_pct`` — the static framework-bytes
+      prediction lands within the bound of the measured ledger
+      high-watermark for a steady-state fused allreduce cycle;
+    * ``pipeline.prediction_error_pct`` — same contract for the MPMD
+      schedule's activation carries;
+    * ``oom_dump.ok`` — a simulated small capacity
+      (``HVD_TPU_MEM_CAPACITY``) produces a flight dump naming the
+      failing executable and the top ledger categories.
+    """
+    import glob as _glob
+    import tempfile
+
+    os.environ["HVD_TPU_COMPRESSION"] = "none"
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.memory import ledger as _mem_ledger
+    from horovod_tpu.memory import planner as _mem_planner
+    from horovod_tpu.ops import megakernel as mk
+    from horovod_tpu.telemetry import flight as _flight
+
+    hvd.init(devices=jax.devices())
+    try:
+        n = hvd.size()
+        led = _mem_ledger.ledger
+        rng = np.random.default_rng(11)
+        base = [rng.standard_normal((n, elems)).astype(np.float32)
+                for _ in range(tensors)]
+        inputs = [hvd.shard(t) for t in base]
+
+        def cycle(tag):
+            hs = [hvd.allreduce_async(x, average=True,
+                                      name=f"{tag}.{j}")
+                  for j, x in enumerate(inputs)]
+            return [hvd.synchronize(h) for h in hs]
+
+        cycle("warm")
+        # Dataplane accuracy (same split-race retry as the dispatch
+        # contract: the prediction models the single fused launch).
+        for attempt in range(8):
+            led.reset()
+            launches0 = mk.stats.launches
+            cycle(f"acc.{attempt}")
+            if mk.stats.launches - launches0 == 1:
+                break
+        dp_measured = led.watermark()
+        dp_predicted = _mem_planner.plan_dataplane(
+            tensors, elems, n).framework_bytes
+        dp_err = (round(abs(dp_predicted - dp_measured)
+                        / dp_measured * 100.0, 2)
+                  if dp_measured else None)
+
+        # Pipeline accuracy: one step of a small MPMD chain.
+        from horovod_tpu.parallel.training import shard_batch
+
+        S, m, d = 3, 4, 32
+
+        def stage_first(p, carry, b):
+            x, _y = b
+            return jnp.tanh(x @ p["w"])
+
+        def stage_mid(p, carry, b):
+            return jnp.tanh(carry @ p["w"])
+
+        def stage_last(p, carry, b):
+            _x, y = b
+            return jnp.mean((carry @ p["w"] - y) ** 2)
+
+        ks = jax.random.split(jax.random.PRNGKey(0), S)
+        params = [{"w": jax.random.normal(k, (d, d)) * d ** -0.5}
+                  for k in ks]
+        B = n * m
+        batch = shard_batch(
+            (np.asarray(jax.random.normal(jax.random.PRNGKey(1),
+                                          (B, d))),
+             np.asarray(jax.random.normal(jax.random.PRNGKey(2),
+                                          (B, d)))))
+        opt = optax.sgd(0.1)
+        step = hvd.make_pipeline_train_step(
+            [stage_first] + [stage_mid] * (S - 2) + [stage_last], opt,
+            num_microbatches=m, fusion_threshold=d * d * 4)
+        led.reset()
+        step(params, opt.init(params), batch)
+        pl_measured = led.peak_by_category().get(
+            "pipeline.activations", 0)
+        pl_predicted = _mem_planner.pipeline_activation_bytes(
+            S, m, microbatch_rows=B // m, width=d)
+        pl_err = (round(abs(pl_predicted - pl_measured)
+                        / pl_measured * 100.0, 2)
+                  if pl_measured else None)
+        led.reset()
+
+        # Plan determinism (the CLI's byte-identity contract).
+        det = all(
+            _mem_planner.build_plan(name, **kw).to_json()
+            == _mem_planner.build_plan(name, **kw).to_json()
+            for name, kw in (
+                ("dataplane", {"tensors": tensors, "elems": elems,
+                               "world": n}),
+                ("transformer_lm", {"batch_size": 64, "world": 8}),
+                ("serving", {"n_layers": 2, "n_heads": 8,
+                             "head_dim": 16, "max_slots": 8,
+                             "pages_per_slot": 8, "page_size": 16}),
+                ("pipeline", {"n_stages": 4, "num_microbatches": 8,
+                              "microbatch_rows": 32, "width": 64,
+                              "world": 8})))
+
+        # Seeded OOM: simulated small capacity -> flight dump naming
+        # the failing executable + top ledger categories.
+        oom = {"ok": False, "executable": None, "top_categories": []}
+        with tempfile.TemporaryDirectory() as td:
+            with _flight.recorder._dump_lock:
+                _flight.recorder._last_dump.clear()
+            os.environ["HVD_TPU_FLIGHT_DIR"] = td
+            os.environ["HVD_TPU_MEM_CAPACITY"] = "4096"
+            led.set("serving.kv_pages", 3000)
+            led.set("megakernel.residuals", 2000)
+            led.set("input.prefetch", 1000)
+            try:
+                cycle("oomseed")  # guard raises, eager fallback runs
+            finally:
+                os.environ.pop("HVD_TPU_FLIGHT_DIR", None)
+                os.environ.pop("HVD_TPU_MEM_CAPACITY", None)
+                led.reset()
+            dumps = _glob.glob(os.path.join(td, "*oom*"))
+            if dumps:
+                extra = json.load(open(dumps[0])).get("extra", {})
+                oom = {
+                    "ok": bool(extra.get("executable"))
+                    and len(extra.get("top_categories", [])) >= 3,
+                    "executable": extra.get("executable"),
+                    "top_categories": [t["category"] for t in
+                                       extra.get("top_categories",
+                                                 [])],
+                }
+
+        # Ledger overhead A/B (informational here; the binding ≤5 %
+        # gate rides --mode dataplane's telemetry section).
+        from horovod_tpu import telemetry as _telemetry
+
+        def timed():
+            lats = []
+            for _ in range(cycles):
+                t0 = time.perf_counter()
+                cycle("ovh")
+                lats.append(time.perf_counter() - t0)
+            lats.sort()
+            return lats[len(lats) // 2]
+
+        lat_on = timed()
+        was_enabled = _telemetry.enabled()
+        _telemetry.set_enabled(False)
+        try:
+            lat_off = timed()
+        finally:
+            _telemetry.set_enabled(was_enabled)
+        ovh = (round((lat_on / lat_off - 1.0) * 100.0, 2)
+               if lat_off else None)
+
+        worst = max(e for e in (dp_err, pl_err) if e is not None) \
+            if (dp_err is not None or pl_err is not None) else None
+        return {
+            "metric": "memory_plan_prediction_error_pct",
+            "value": worst,
+            "unit": "%",
+            "vs_baseline": None,
+            "dataplane": {"ledger_peak_bytes": dp_measured,
+                          "planner_predicted_bytes": dp_predicted,
+                          "prediction_error_pct": dp_err},
+            "pipeline": {"ledger_peak_bytes": pl_measured,
+                         "planner_predicted_bytes": pl_predicted,
+                         "prediction_error_pct": pl_err},
+            "plan_deterministic": det,
+            "oom_dump": oom,
+            "ledger_overhead_pct": ovh,
+            "tensors": tensors,
+            "elems": elems,
+            "replicas": n,
         }
     finally:
         hvd.shutdown()
@@ -1532,7 +1810,8 @@ def main() -> int:
                     help="tiny shapes for CPU sanity checks")
     ap.add_argument("--mode",
                     choices=["resnet", "control", "dataplane", "input",
-                             "serving", "overlap", "pipeline"],
+                             "serving", "overlap", "pipeline",
+                             "memory"],
                     default="resnet",
                     help="control = control-plane negotiations/sec only "
                          "(no XLA, no TPU tunnel); dataplane = "
@@ -1552,7 +1831,10 @@ def main() -> int:
                          "GPipe-ordered dispatch of the same per-stage "
                          "executables — steps/sec, exposed-bubble "
                          "seconds, bitwise + reference parity gates "
-                         "(no TPU tunnel)")
+                         "(no TPU tunnel); memory = hvd-mem planner "
+                         "accuracy vs the live ledger, plan "
+                         "determinism, and the seeded-OOM forensics "
+                         "path (no TPU tunnel)")
     ap.add_argument("--check-speedup", type=float, default=None,
                     help="control mode: exit nonzero when the cache-on/"
                          "cache-off speedup is below this bound; "
@@ -1585,6 +1867,14 @@ def main() -> int:
                          "throughput floor vs the adjacent uncompressed "
                          "leg (parity on a quiet box; the floor keeps "
                          "the CI gate load-proof)")
+    ap.add_argument("--check-memory-plan", type=float, default=None,
+                    help="memory mode: exit nonzero when the planner's "
+                         "framework-bytes prediction misses the "
+                         "measured ledger high-watermark by more than "
+                         "this percentage on either leg, when repeated "
+                         "plans are not byte-identical, or when the "
+                         "seeded RESOURCE_EXHAUSTED fails to dump the "
+                         "executable + top ledger categories")
     ap.add_argument("--check-tree-frames", type=float, default=None,
                     help="with --mode control: fail unless rank-0 rx "
                          "frames per simulated cycle stay under "
@@ -1713,6 +2003,40 @@ def main() -> int:
                 failures.append(
                     f"int8 leg at {spd}x of the uncompressed "
                     f"megakernel throughput (floor 0.5x)")
+            if failures:
+                for f in failures:
+                    print(f"FAIL: {f}", file=sys.stderr)
+                return 1
+        return 0
+
+    if args.mode == "memory":
+        # CPU-only like --mode dataplane: pin the 8-virtual-device mesh
+        # before the first jax import (same bootstrap as conftest.py).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        result = _memory_bench()
+        print(json.dumps(result))
+        if args.check_memory_plan is not None:
+            failures = []
+            for leg in ("dataplane", "pipeline"):
+                err = (result.get(leg) or {}).get(
+                    "prediction_error_pct")
+                if err is None or err > args.check_memory_plan:
+                    failures.append(
+                        f"{leg} planner prediction off by {err}% "
+                        f"(bound {args.check_memory_plan}%)")
+            if not result.get("plan_deterministic"):
+                failures.append(
+                    "repeated plans are not byte-identical")
+            if not (result.get("oom_dump") or {}).get("ok"):
+                failures.append(
+                    f"seeded RESOURCE_EXHAUSTED did not produce the "
+                    f"forensic dump: {result.get('oom_dump')}")
             if failures:
                 for f in failures:
                     print(f"FAIL: {f}", file=sys.stderr)
@@ -2037,13 +2361,18 @@ def _pipeline_or_error(timeout: float = 240.0) -> dict:
         os.environ.pop("HVD_TPU_BENCH_PIPELINE_QUICK", None)
 
 
+def _memory_or_error(timeout: float = 240.0) -> dict:
+    return _child_bench_or_error("memory", timeout)
+
+
 def _fail_json(error: str, attempts: int, attempt_log=None,
                control=None, dataplane=None, inputpipe=None,
-               serving=None, overlap=None, pipeline=None) -> int:
+               serving=None, overlap=None, pipeline=None,
+               memory=None) -> int:
     """Persistent failure: one parseable JSON line, not a traceback.
-    The control-, data-plane, input-pipeline, serving, overlap and
-    pipeline numbers still ride along — none can be taken down by the
-    tunnel, so every round records at least those."""
+    The control-, data-plane, input-pipeline, serving, overlap,
+    pipeline and memory numbers still ride along — none can be taken
+    down by the tunnel, so every round records at least those."""
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
         "value": None,
@@ -2064,6 +2393,8 @@ def _fail_json(error: str, attempts: int, attempt_log=None,
         else _overlap_or_error(),
         "pipeline": pipeline if pipeline is not None
         else _pipeline_or_error(),
+        "memory": memory if memory is not None
+        else _memory_or_error(),
     }))
     return 1
 
@@ -2101,6 +2432,7 @@ def _supervise(args) -> int:
     serving = _serving_or_error()
     overlap = _overlap_or_error()
     pipeline = _pipeline_or_error()
+    memory = _memory_or_error()
 
     def remaining() -> float:
         return deadline - time.monotonic()
@@ -2161,7 +2493,7 @@ def _supervise(args) -> int:
             f"{time.monotonic() - t_start:.0f}s (TPU tunnel down/hung?)",
             attempts=0, attempt_log=attempt_log, control=control,
             dataplane=dataplane, inputpipe=inputpipe, serving=serving,
-            overlap=overlap, pipeline=pipeline)
+            overlap=overlap, pipeline=pipeline, memory=memory)
 
     # Phase 1 — measurement attempts, each clamped to remaining budget.
     last_err = "unknown"
@@ -2204,7 +2536,7 @@ def _supervise(args) -> int:
                           attempt_log=attempt_log, control=control,
                           dataplane=dataplane, inputpipe=inputpipe,
                           serving=serving, overlap=overlap,
-                          pipeline=pipeline)
+                          pipeline=pipeline, memory=memory)
 
     # Phase 2 — eager/dynamic-path smoke on the real chip (budget
     # permitting).  Failure is reported, not fatal: the headline number
@@ -2228,6 +2560,7 @@ def _supervise(args) -> int:
     payload["serving"] = serving
     payload["overlap"] = overlap
     payload["pipeline"] = pipeline
+    payload["memory"] = memory
     payload["attempt_log"] = attempt_log
     print(json.dumps(payload))
     return 0

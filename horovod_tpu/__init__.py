@@ -120,5 +120,7 @@ from . import serving  # noqa: F401  (hvd.serving.InferenceEngine & co)
 from . import trace  # noqa: F401  (hvd.trace spans & clock alignment)
 from .trace.merge import dump_fleet_trace  # noqa: F401
 from .trace.watch import StragglerWatch  # noqa: F401
+from . import memory  # noqa: F401  (hvd.memory: ledger/planner/oom)
+from .memory import MemoryWatch  # noqa: F401
 
 __version__ = "0.1.0"

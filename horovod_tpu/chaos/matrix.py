@@ -268,6 +268,48 @@ def _cp_names() -> List[str]:
     return [f"t{k}" for k in range(CP_TENSORS)]
 
 
+# hvd-mem: every cp rank seeds a rank-keyed ledger entry at fleet
+# start, so the mid-run FRAME_METRICS / FRAME_METRICS_TREE pull can
+# assert the memory gauge family aggregates EXACTLY — per-rank values
+# from every rank, fleet min/max/mean bit-for-bit (tests/test_tree.py
+# extends its metrics-pull leg over this).
+MEM_PROBE_GAUGE = "memory.bytes.chaos.probe"
+
+
+def _seed_mem_probe(rank: int) -> None:
+    from ..memory import ledger as _mem
+
+    _mem.ledger.set("chaos.probe", (rank + 1) << 20)
+
+
+def _check_mem_gauges(snaps, np_: int) -> None:
+    """Controller-side exactness assertion over one completed pull:
+    the seeded probe gauge must arrive from EVERY rank with its exact
+    per-rank value, and the fleet min/max/mean must be exact integers
+    of the seeded arithmetic — any drop or mangling through the tree
+    merge is a loud _diag, not a silent coverage gap."""
+    from .. import telemetry as _telemetry
+
+    agg = _telemetry.aggregate(snaps).get(MEM_PROBE_GAUGE)
+    if agg is None:
+        _diag(0, f"metrics pull carried no {MEM_PROBE_GAUGE} gauge "
+                 f"(snapshot keys: "
+                 f"{sorted(next(iter(snaps.values())))[:8]}...)")
+    expect = {r: (r + 1) << 20 for r in range(np_)}
+    got = {int(r): int(v) for r, v in agg.get("per_rank", {}).items()}
+    if got != expect:
+        _diag(0, f"{MEM_PROBE_GAUGE} per-rank mismatch: got {got}, "
+                 f"expected {expect}")
+    exact = {"min": 1 << 20, "max": np_ << 20,
+             "mean": ((np_ + 1) << 20) / 2.0,
+             "sum": (np_ * (np_ + 1) // 2) << 20}
+    for key, want in exact.items():
+        if agg.get(key) != want:
+            _diag(0, f"{MEM_PROBE_GAUGE} {key} inexact: "
+                     f"{agg.get(key)} != {want}")
+    print(f"CHAOS_MEMGAUGES ranks={np_} ok", flush=True)
+
+
 def run_cp_controller(np_: int, port: int) -> None:
     """Rank 0 of the cp fleet: the real ControllerTransport +
     Coordinator + ResponseCache, driven by a drain loop mirroring
@@ -280,6 +322,7 @@ def run_cp_controller(np_: int, port: int) -> None:
     from ..ops.coordinator import Coordinator
     from ..ops.wire import Response, ResponseType
 
+    _seed_mem_probe(0)
     cache = (_cache_mod.ResponseCache(rank=0)
              if _cache_mod.cache_enabled() else None)
     coord = Coordinator(size=np_, fusion_threshold=_THRESHOLD,
@@ -359,10 +402,14 @@ def run_cp_controller(np_: int, port: int) -> None:
             # exercises the merged FRAME_METRICS_TREE aggregation (and
             # after an interior fault, the re-parented paths); every
             # live rank must answer.
-            snaps = ctrl.collect_metrics({"rank": 0}, timeout=10.0)
+            from .. import telemetry as _telemetry
+
+            snaps = ctrl.collect_metrics(_telemetry.metrics(),
+                                         timeout=10.0)
             if len(snaps) < np_:
                 _diag(0, f"metrics pull covered only "
                          f"{sorted(snaps)} of {np_} ranks")
+            _check_mem_gauges(snaps, np_)
     _result(0, records)
     ctrl.broadcast_responses([Response(ResponseType.SHUTDOWN)])
     time.sleep(0.3)  # let the workers drain the shutdown
@@ -377,6 +424,7 @@ def run_cp_worker(rank: int, port: int, np_: int = 2) -> None:
     from ..ops import transport as T
     from ..ops.wire import ResponseType
 
+    _seed_mem_probe(rank)
     kill_step = int(os.environ.get("HVD_TPU_CHAOS_KILL_STEP", "-1"))
     layout = _cp_layout(np_)
     if layout is not None:

@@ -178,6 +178,7 @@ def init(devices=None) -> None:
     # control-plane HELLO handshake — ops/transport.py warns naming the
     # rank and the divergent knobs.)
     from .. import chaos as _chaos_env
+    from ..memory import oom as _mem_oom
     from ..ops import compression as _compression_env
     from ..ops import tree as _tree_env
     from ..parallel import overlap as _overlap_env
@@ -189,6 +190,8 @@ def init(devices=None) -> None:
     _overlap_env.validate_env()
     _pipeline_env.validate_env()
     _tree_env.validate_env()
+    # hvd-mem: a typo'd HVD_TPU_MEM_CAPACITY must fail init too.
+    _mem_oom.validate_env()
     # hvd-chaos: a typo'd HVD_TPU_FAULTS clause must abort init with
     # the valid site/key list, not silently run a fault-free "chaos"
     # job (docs/chaos.md).
@@ -345,8 +348,13 @@ def init(devices=None) -> None:
         # HVD_TPU_METRICS_PORT is set, serve /metrics + /healthz — rank
         # 0 only unless HVD_TPU_METRICS_ALL_RANKS=1 (docs/metrics.md).
         from .. import telemetry as _telemetry
+        from ..memory import ledger as _mem_ledger
 
         _telemetry.install_runtime_collector()
+        # hvd-mem: (re-)register the memory gauge collector — ledger
+        # categories, watermarks, device.memory_stats() — so per-rank
+        # HBM rides every FRAME_METRICS / FRAME_METRICS_TREE pull.
+        _mem_ledger.install_collector()
         port = os.environ.get("HVD_TPU_METRICS_PORT")
         if port and _state.metrics_exporter is None and (
                 _state.process_index == 0
@@ -388,6 +396,32 @@ def init(devices=None) -> None:
         from ..ops import megakernel as _megakernel
 
         _megakernel.warm_start(_state.mesh, cache_dir)
+    # hvd-mem pre-flight (docs/memory.md): when the per-rank HBM
+    # capacity is known (backend memory_stats or HVD_TPU_MEM_CAPACITY),
+    # size the largest recorded executable — the warm-start manifest's
+    # fusion groups and any harvested memory_analysis() — against it
+    # and WARN before the first training step.
+    try:
+        from ..memory import planner as _mem_planner
+
+        if _mem_oom.advertised_capacity() is not None:
+            # Per-DEVICE figures against the per-device capacity: the
+            # manifest's device-bytes peak (not the 2·world global
+            # model) and the harvest's own per-executable analysis
+            # (XLA reports per-device numbers).
+            man = (_mem_planner.manifest_section(cache_dir)
+                   if cache_dir else {})
+            harv = _mem_planner.harvest_section()
+            predicted = max(
+                int(man.get("peak_group_device_bytes") or 0),
+                int(harv.get("peak_executable_bytes") or 0))
+            if predicted:
+                _mem_oom.preflight_warn(
+                    predicted, "hvd.init",
+                    "largest recorded executable footprint "
+                    "(per-device)")
+    except Exception:  # noqa: BLE001 — pre-flight must not break init
+        pass
 
 
 def _configure_compile_cache(directory: str) -> None:

@@ -1,0 +1,59 @@
+"""hvd-mem: fleet-wide HBM observability (docs/memory.md).
+
+Three coupled halves, the same vertical slice hvd-trace cut on the
+orthogonal axis — device **memory** instead of time:
+
+* :mod:`~horovod_tpu.memory.ledger` — the live byte ledger fed by every
+  framework-owned allocation site (fusion buffers, EF residuals, KV
+  pages, prefetch slots, pipeline carries, checkpoint snapshots),
+  surfaced as ``memory.*`` telemetry gauges that ride the
+  FRAME_METRICS / FRAME_METRICS_TREE fleet pull — so
+  ``hvd.cluster_metrics()`` reports per-rank HBM min/max/mean for free
+  — plus :class:`~horovod_tpu.memory.ledger.MemoryWatch`, the live
+  leak detector.
+* :mod:`~horovod_tpu.memory.planner` — the static memory planner:
+  analytic byte models shared with the runtime accounting sites,
+  harvested ``compiled.memory_analysis()`` per AOT executable, and
+  ``python -m horovod_tpu.memory --plan`` as the no-hardware dryrun
+  answering "will this config fit" and its what-ifs.
+* :mod:`~horovod_tpu.memory.oom` — RESOURCE_EXHAUSTED capture at the
+  dispatch sites: a forensic flight dump naming the failing executable
+  and the top ledger categories, a simulated-capacity knob
+  (``HVD_TPU_MEM_CAPACITY``) and the init/build-time pre-flight
+  warnings.
+"""
+
+from __future__ import annotations
+
+from . import ledger  # noqa: F401  (import installs collector + tail)
+from . import oom  # noqa: F401
+from . import planner  # noqa: F401
+from .ledger import (  # noqa: F401
+    MemoryLedger,
+    MemoryWatch,
+    device_memory_stats,
+    live_array_report,
+    tree_nbytes,
+)
+
+# The process-global ledger instance lives at memory.ledger.ledger (the
+# flight/recorder convention); re-exporting it here as ``ledger`` would
+# shadow the submodule for every ``from ..memory import ledger`` site.
+from .oom import (  # noqa: F401
+    ResourceExhaustedError,
+    advertised_capacity,
+    guard,
+    is_resource_exhausted,
+    oom_event,
+    preflight_warn,
+)
+from .planner import (  # noqa: F401
+    MemoryPlan,
+    build_plan,
+    fusion_group_bytes,
+    harvested,
+    kv_cache_bytes,
+    model_names,
+    pipeline_activation_bytes,
+    record_compiled,
+)

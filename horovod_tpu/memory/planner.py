@@ -1,0 +1,476 @@
+"""Static memory planner: predict peak HBM per rank before a launch
+(hvd-mem piece 2, docs/memory.md).
+
+Two inputs, one plan:
+
+* **Analytic models** of every framework-owned allocation the ledger
+  (memory/ledger.py) accounts at runtime — fusion buffers, EF
+  residuals, KV pages, prefetch slots, pipeline carries, checkpoint
+  snapshots — PLUS the workload-owned big four (params, optimizer
+  state, gradients, activations).  The byte formulas are shared with
+  the runtime accounting sites (``fusion_group_bytes`` is the SAME
+  function ``ops/megakernel.launch`` charges the ledger with), so the
+  plan-vs-measured comparison is a real consistency check, not two
+  guesses shaking hands.
+* **Harvested ``compiled.memory_analysis()``** from every AOT-compile
+  point the repo owns — the megakernel manifest warm-start path, the
+  per-stage pipeline executables, serving prefill/decode buckets —
+  recorded per executable by :func:`record_compiled` where the backend
+  implements the query (TPU does; CPU returns nothing and the plan
+  says so instead of inventing numbers).
+
+``python -m horovod_tpu.memory --plan`` is the no-hardware dryrun
+surface (the ``hvd.schedule_plan`` convention): answer "will this
+config fit" — and what-if variants (batch size, microbatch count, KV
+pages, interleave) — without compiling anything twice.  Plan JSON is
+byte-identical for identical configs (sorted keys, no clocks), which
+the CI ``memory`` job gates.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..analysis import lockorder as _lockorder
+
+PLAN_FORMAT = "hvd-mem-plan-v1"
+
+_DTYPE_BYTES = {
+    "float32": 4, "f32": 4, "float64": 8, "bfloat16": 2, "float16": 2,
+    "int32": 4, "int8": 1, "uint8": 1, "int64": 8,
+}
+
+
+def dtype_bytes(dtype) -> int:
+    """Item size without importing jax (the CLI must answer on a box
+    with nothing initialized); jax/numpy dtypes resolve via their
+    itemsize, strings via the table."""
+    itemsize = getattr(dtype, "itemsize", None)
+    if itemsize:
+        return int(itemsize)
+    name = str(getattr(dtype, "name", dtype)).lower()
+    if name in _DTYPE_BYTES:
+        return _DTYPE_BYTES[name]
+    raise ValueError(f"unknown dtype {dtype!r}; expected one of "
+                     f"{sorted(_DTYPE_BYTES)}")
+
+
+# ---------------------------------------------------------------------------
+# Shared byte models (the ledger's accounting sites use these too)
+# ---------------------------------------------------------------------------
+
+def fusion_group_bytes(shapes: Tuple[Tuple[int, ...], ...], dtype,
+                       world: int, variant: str = "sp_pr") -> int:
+    """Bytes one megakernel launch holds live: the group's input
+    contributions plus its outputs (the packed intermediate aliases
+    into them under XLA's donation).  Per-replica variants carry a
+    ``world``-leading axis on both sides; replicated/mp payloads are
+    single-copy.  This is the function ``ops/megakernel.launch``
+    charges the ledger with — prediction and measurement share one
+    model by construction."""
+    item = dtype_bytes(dtype)
+    payload = sum(int(math.prod(s)) if s else 1 for s in shapes) * item
+    lead = world if variant in ("sp_pr", "mp") else 1
+    return 2 * lead * payload
+
+
+def fusion_group_device_bytes(shapes: Tuple[Tuple[int, ...], ...],
+                              dtype) -> int:
+    """PER-DEVICE footprint of one launch — what capacity checks
+    compare against a per-device HBM figure.  Uniform across variants:
+    a per-replica array holds one row per device, a replicated or mp
+    payload one full copy, so each device carries one payload of
+    inputs plus one of outputs.  (:func:`fusion_group_bytes` is the
+    GLOBAL model the ledger/planner consistency contract shares — a
+    2·world multiple of this on the per-replica variants.)"""
+    item = dtype_bytes(dtype)
+    return 2 * sum(int(math.prod(s)) if s else 1 for s in shapes) * item
+
+
+def kv_cache_bytes(n_layers: int, n_heads: int, head_dim: int,
+                   max_slots: int, pages_per_slot: int, page_size: int,
+                   dtype="float32") -> int:
+    """K + V page arrays of serving/kv_cache.PagedKVCache (the +1 is
+    the reserved trash page)."""
+    n_pages = 1 + max_slots * pages_per_slot
+    return (2 * n_layers * n_pages * page_size * n_heads * head_dim
+            * dtype_bytes(dtype))
+
+
+def pipeline_activation_bytes(n_stages: int, num_microbatches: int,
+                              microbatch_rows: int, width: int,
+                              dtype="float32",
+                              schedule: Optional[str] = None,
+                              interleave: Optional[int] = None) -> int:
+    """Peak stage-boundary carry bytes under the resolved schedule:
+    ``schedule_plan(...).peak_activations`` (the event-simulated dryrun,
+    parallel/pipeline.py) times one carry's GLOBAL bytes.  1F1B bounds
+    this at the stage depth; GPipe grows it with the microbatch count —
+    the what-if the CLI answers."""
+    from ..parallel.pipeline import schedule_plan
+
+    plan = schedule_plan(n_stages, num_microbatches, schedule=schedule,
+                         interleave=interleave)
+    carry = microbatch_rows * width * dtype_bytes(dtype)
+    return plan.peak_activations * carry
+
+
+def prefetch_bytes(depth: int, batch_bytes: int) -> int:
+    """Staged device batches a prefetcher may hold at once
+    (parallel/input.py: ``depth`` queued plus the one in flight on the
+    stager thread)."""
+    return (depth + 1) * batch_bytes
+
+
+# ---------------------------------------------------------------------------
+# Harvest: compiled.memory_analysis() per AOT executable
+# ---------------------------------------------------------------------------
+
+_harvest_lock = _lockorder.make_lock("memory.planner._harvest_lock")
+_harvest: Dict[str, Dict[str, int]] = {}  # guarded_by: _harvest_lock
+
+# The numeric fields jax's MemoryAnalysis exposes (names vary a little
+# across jaxlib versions; we scan for the stable *_in_bytes suffix).
+_ANALYSIS_SUFFIX = "_in_bytes"
+
+
+def record_compiled(name: str, compiled) -> Optional[Dict[str, int]]:
+    """Harvest ``compiled.memory_analysis()`` into the process-global
+    table, keyed by executable name.  Returns the harvested dict, or
+    None when the backend does not implement the query (XLA:CPU) — the
+    plan's ``compiled`` section then reports coverage honestly instead
+    of zeros.  Never raises: harvesting is observability."""
+    try:
+        analysis = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — Unimplemented on CPU, AttributeError
+        return None    # on old jax: the planner works without it
+    if analysis is None:
+        return None
+    out: Dict[str, int] = {}
+    for attr in dir(analysis):
+        if attr.endswith(_ANALYSIS_SUFFIX) and not attr.startswith("_"):
+            try:
+                out[attr] = int(getattr(analysis, attr))
+            except (TypeError, ValueError):
+                continue
+    if not out:
+        return None
+    with _harvest_lock:
+        _harvest[name] = out
+    return out
+
+
+def harvested() -> Dict[str, Dict[str, int]]:
+    with _harvest_lock:
+        return {k: dict(v) for k, v in _harvest.items()}
+
+
+def clear_harvest() -> None:
+    with _harvest_lock:
+        _harvest.clear()
+
+
+def harvest_section() -> Dict[str, Any]:
+    """The plan's ``compiled`` section: per-executable
+    ``memory_analysis`` numbers plus the peak over executables of
+    (argument + output + temp) — the XLA-reported live-set bound for
+    the single executable whose dispatch peaks."""
+    table = harvested()
+    peak = 0
+    peak_name = None
+    for name, fields in table.items():
+        live = sum(fields.get(k, 0) for k in (
+            "argument_size_in_bytes", "output_size_in_bytes",
+            "temp_size_in_bytes"))
+        if live > peak:
+            peak, peak_name = live, name
+    return {
+        "executables": {k: dict(sorted(v.items()))
+                        for k, v in sorted(table.items())},
+        "peak_executable": peak_name,
+        "peak_executable_bytes": peak,
+        "coverage": len(table),
+    }
+
+
+def manifest_section(directory: Optional[str] = None) -> Dict[str, Any]:
+    """Static fusion-buffer predictions for every megakernel the
+    persistent-cache manifest recorded (the warm-start path's
+    executables) — how a FRESH process plans a mesh it has not compiled
+    on yet.  Serving entries contribute their KV/config identity, group
+    entries their :func:`fusion_group_bytes`."""
+    from ..ops import megakernel as _mk
+
+    d = directory or _mk.compile_cache_dir()
+    if d is None:
+        return {"entries": 0, "peak_group_bytes": 0,
+                "peak_group_device_bytes": 0}
+    peak = 0
+    peak_dev = 0
+    entries = 0
+    for entry in _mk.load_manifest(d):
+        if entry.get("variant") not in ("sp_pr", "sp_rep"):
+            continue
+        entries += 1
+        shapes = tuple(tuple(s) for s in entry.get("shapes", ()))
+        world = int((entry.get("mesh") or {}).get("count", 1))
+        dtype = entry.get("dtype", "float32")
+        peak = max(peak, fusion_group_bytes(
+            shapes, dtype, world, entry.get("variant", "sp_pr")))
+        peak_dev = max(peak_dev,
+                       fusion_group_device_bytes(shapes, dtype))
+    return {"entries": entries, "peak_group_bytes": peak,
+            "peak_group_device_bytes": peak_dev}
+
+
+# ---------------------------------------------------------------------------
+# The plan
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MemoryPlan:
+    """One resolved memory plan.  ``sections`` maps workload components
+    to byte figures; ``framework`` is the ledger-covered subset — the
+    half the runtime measures, so ``framework_bytes`` vs the ledger's
+    high watermark is the accuracy contract (±15 %, CI-gated).
+    ``to_json()`` is deterministic: identical config ⇒ byte-identical
+    output (sorted keys, no clocks, no environment echoes beyond the
+    config itself)."""
+
+    model: str
+    config: Dict[str, Any]
+    world: int
+    sections: Dict[str, int] = field(default_factory=dict)
+    framework: Dict[str, int] = field(default_factory=dict)
+    facts: Dict[str, Any] = field(default_factory=dict)
+    capacity_bytes: Optional[int] = None
+
+    @property
+    def framework_bytes(self) -> int:
+        return sum(self.framework.values())
+
+    @property
+    def per_rank_bytes(self) -> int:
+        return self.framework_bytes + sum(self.sections.values())
+
+    def to_dict(self) -> Dict[str, Any]:
+        fits = None
+        headroom = None
+        if self.capacity_bytes:
+            headroom = self.capacity_bytes - self.per_rank_bytes
+            fits = headroom >= 0
+        return {
+            "format": PLAN_FORMAT,
+            "model": self.model,
+            "config": dict(sorted(self.config.items())),
+            "world": self.world,
+            "sections": dict(sorted(self.sections.items())),
+            "facts": dict(sorted(self.facts.items())),
+            "framework": dict(sorted(self.framework.items())),
+            "framework_bytes": self.framework_bytes,
+            "per_rank_bytes": self.per_rank_bytes,
+            "capacity_bytes": self.capacity_bytes,
+            "headroom_bytes": headroom,
+            "fits": fits,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True, indent=1)
+
+
+def _transformer_param_bytes(vocab_size: int, d_model: int,
+                             n_heads: int, n_layers: int, d_ff: int,
+                             max_seq_len: int, dtype="float32") -> int:
+    """Parameter bytes of models/transformer.init_transformer, computed
+    from the layer shapes (embedding + positional, per layer QKV/out
+    projections, two FFN matrices, two layernorm pairs, final norm +
+    untied head) — pure arithmetic, no tracing, so the CLI stays
+    hardware-free and deterministic."""
+    item = dtype_bytes(dtype)
+    per_layer = (4 * d_model * d_model + 4 * d_model        # attn + bias
+                 + 2 * d_model * d_ff + d_ff + d_model      # ffn
+                 + 4 * d_model)                             # 2 x ln
+    total = (vocab_size * d_model                           # embedding
+             + max_seq_len * d_model                        # positions
+             + n_layers * per_layer
+             + 2 * d_model                                  # final ln
+             + d_model * vocab_size + vocab_size)           # lm head
+    return total * item
+
+
+_OPTIMIZER_SLOTS = {"adam": 2, "adamw": 2, "sgd": 0, "momentum": 1,
+                    "none": 0}
+
+
+def plan_dataplane(tensors: int, elems: int, world: int,
+                   dtype: str = "float32",
+                   fusion_threshold: Optional[int] = None,
+                   capacity: Optional[int] = None) -> MemoryPlan:
+    """Plan for the dataplane steady state (``bench.py --mode
+    dataplane``'s workload): a ``tensors``-wide allreduce program.  The
+    framework peak is the largest fusion group's launch footprint under
+    the threshold partition (groups are filled greedily in submission
+    order — the coordinator's plan_fusion policy)."""
+    item = dtype_bytes(dtype)
+    thr = fusion_threshold if fusion_threshold is not None \
+        else int(os.environ.get("HOROVOD_FUSION_THRESHOLD",
+                                str(64 << 20)))
+    per_tensor = elems * item
+    groups: List[int] = []
+    cur = 0
+    for _ in range(tensors):
+        if cur and cur + per_tensor > thr:
+            groups.append(cur)
+            cur = 0
+        cur += per_tensor
+    if cur:
+        groups.append(cur)
+    peak_group = max(groups) if groups else 0
+    fusion = fusion_group_bytes(
+        ((peak_group // item,),), dtype, world, "sp_pr")
+    return MemoryPlan(
+        model="dataplane",
+        config={"tensors": tensors, "elems": elems, "dtype": dtype,
+                "fusion_threshold": thr},
+        world=world,
+        sections={"tensors": tensors * world * per_tensor},
+        facts={"fusion_groups": len(groups),
+               "peak_group_payload_bytes": peak_group},
+        framework={"megakernel.fusion": fusion},
+        capacity_bytes=capacity)
+
+
+def plan_pipeline(n_stages: int, num_microbatches: int,
+                  microbatch_rows: int, width: int, world: int,
+                  schedule: Optional[str] = None,
+                  interleave: Optional[int] = None,
+                  dtype: str = "float32",
+                  stage_param_bytes: Optional[int] = None,
+                  capacity: Optional[int] = None) -> MemoryPlan:
+    """Plan for the MPMD pipeline step: carries from the event-simulated
+    schedule plan (the 1F1B-vs-GPipe what-if), stage parameters /
+    gradient accumulators, and the per-stage bucket reduction's fusion
+    transient."""
+    from ..parallel.pipeline import schedule_plan
+
+    plan = schedule_plan(n_stages, num_microbatches, schedule=schedule,
+                         interleave=interleave)
+    item = dtype_bytes(dtype)
+    sp = stage_param_bytes if stage_param_bytes is not None \
+        else (width * width + width) * item
+    carry = microbatch_rows * width * item
+    activations = plan.peak_activations * carry
+    fusion = 2 * world * sp  # largest stage bucket's launch footprint
+    return MemoryPlan(
+        model="pipeline",
+        config={"n_stages": n_stages,
+                "num_microbatches": num_microbatches,
+                "microbatch_rows": microbatch_rows, "width": width,
+                "schedule": plan.schedule,
+                "interleave": plan.interleave, "dtype": dtype},
+        world=world,
+        sections={"params": n_stages * sp,
+                  "gradient_accumulators": n_stages * world * sp},
+        facts={"peak_activation_carries": plan.peak_activations,
+               "bubble_fraction": round(plan.bubble_fraction, 4)},
+        framework={"pipeline.activations": activations,
+                   "megakernel.fusion": fusion},
+        capacity_bytes=capacity)
+
+
+def plan_serving(n_layers: int, n_heads: int, head_dim: int,
+                 max_slots: int, pages_per_slot: int, page_size: int,
+                 world: int = 1, dtype: str = "float32",
+                 param_bytes: int = 0,
+                 capacity: Optional[int] = None) -> MemoryPlan:
+    """Plan for the serving engine: the paged KV store (the dominant
+    framework buffer) plus replicated params.  The KV what-ifs —
+    slots, pages per slot, page size — are the router tier's capacity
+    question (ROADMAP item 2)."""
+    kv = kv_cache_bytes(n_layers, n_heads, head_dim, max_slots,
+                        pages_per_slot, page_size, dtype)
+    return MemoryPlan(
+        model="serving",
+        config={"n_layers": n_layers, "n_heads": n_heads,
+                "head_dim": head_dim, "max_slots": max_slots,
+                "pages_per_slot": pages_per_slot,
+                "page_size": page_size, "dtype": dtype},
+        world=world,
+        sections={"params": param_bytes},
+        facts={"kv_capacity_tokens": max_slots * pages_per_slot
+               * page_size},
+        framework={"serving.kv_pages": kv},
+        capacity_bytes=capacity)
+
+
+def plan_transformer_lm(vocab_size: int = 256, d_model: int = 128,
+                        n_heads: int = 8, n_layers: int = 2,
+                        d_ff: int = 256, max_seq_len: int = 64,
+                        batch_size: int = 32, world: int = 1,
+                        optimizer: str = "adam",
+                        prefetch_depth: int = 2,
+                        dtype: str = "float32",
+                        capacity: Optional[int] = None) -> MemoryPlan:
+    """End-to-end training plan for the transformer LM example: params
+    + optimizer slots + gradients + a coarse activation model
+    (per-token residual-stream floats across the layer stack; remat
+    halves it in practice — the figure is an upper bound, documented in
+    docs/memory.md) + the framework buffers (fusion launch of the
+    largest gradient group, prefetch staging, one checkpoint
+    snapshot)."""
+    if optimizer not in _OPTIMIZER_SLOTS:
+        raise ValueError(f"unknown optimizer {optimizer!r}; expected "
+                         f"one of {sorted(_OPTIMIZER_SLOTS)}")
+    item = dtype_bytes(dtype)
+    params = _transformer_param_bytes(vocab_size, d_model, n_heads,
+                                      n_layers, d_ff, max_seq_len,
+                                      dtype)
+    opt = _OPTIMIZER_SLOTS[optimizer] * params
+    grads = params
+    per_rank_batch = max(1, batch_size // max(1, world))
+    activations = (per_rank_batch * max_seq_len
+                   * (2 * d_model + d_ff) * n_layers * item)
+    batch_bytes = per_rank_batch * max_seq_len * 4 * 2  # tokens+targets
+    fusion = fusion_group_bytes(((params // item,),), dtype, world,
+                                "sp_pr")
+    return MemoryPlan(
+        model="transformer_lm",
+        config={"vocab_size": vocab_size, "d_model": d_model,
+                "n_heads": n_heads, "n_layers": n_layers,
+                "d_ff": d_ff, "max_seq_len": max_seq_len,
+                "batch_size": batch_size, "optimizer": optimizer,
+                "prefetch_depth": prefetch_depth, "dtype": dtype},
+        world=world,
+        sections={"params": params, "optimizer_state": opt,
+                  "gradients": grads, "activations": activations},
+        framework={"megakernel.fusion": fusion,
+                   "input.prefetch": prefetch_bytes(prefetch_depth,
+                                                    batch_bytes),
+                   "checkpoint.snapshots": params},
+        capacity_bytes=capacity)
+
+
+_MODELS = {
+    "dataplane": plan_dataplane,
+    "pipeline": plan_pipeline,
+    "serving": plan_serving,
+    "transformer_lm": plan_transformer_lm,
+}
+
+
+def model_names() -> Tuple[str, ...]:
+    return tuple(sorted(_MODELS))
+
+
+def build_plan(model: str, **kwargs) -> MemoryPlan:
+    """Resolve one plan by model name (the CLI surface; a typo names
+    every valid model, the ``hvd.init`` knob-validation convention)."""
+    fn = _MODELS.get(model)
+    if fn is None:
+        raise ValueError(f"unknown plan model {model!r}; expected one "
+                         f"of {', '.join(model_names())}")
+    return fn(**kwargs)

@@ -32,7 +32,14 @@ and EQuARX's in-XLA quantized allreduce, arXiv:2506.17615):
   difference between what a replica meant to send and what its peers
   decoded) is carried by the executor and added to the next step's
   contribution, so the error telescopes instead of accumulating
-  (SNIPPETS.md §EF-SGD lineage).
+  (SNIPPETS.md §EF-SGD lineage).  The residual store is real HBM — one
+  flat full-precision buffer per fusion group, held across steps by
+  ``ops/megakernel.py`` for the fused AND eager-reference paths alike —
+  and is accounted by the hvd-mem device-memory ledger as
+  ``megakernel.residuals`` (docs/memory.md): its absolute byte size is
+  re-synced on every store/take/flush, so a name churn that
+  re-partitions groups and mints fresh residuals shows up as ledger
+  growth ``hvd.MemoryWatch`` names.
 
 Per-tensor / per-process-set selection rides a small policy registry
 (:func:`set_compression`): regex rules map tensor names to compressor

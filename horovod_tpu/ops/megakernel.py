@@ -91,6 +91,9 @@ from ..core.state import REPLICA_AXIS
 from ..utils import xla_dispatch as _xla_dispatch
 from .. import telemetry as _telemetry
 from .. import trace as _trace
+from ..memory import ledger as _mem
+from ..memory import oom as _oom
+from ..memory import planner as _mem_planner
 from . import compression as _compression
 from .wire import ReduceOp
 
@@ -275,6 +278,7 @@ def flush(reason: str) -> None:
         _residuals.clear()
         _ticks.clear()
         stats.flushes += 1
+    _sync_residual_ledger()
     if n or nr:
         print(f"[hvd-megakernel] cache flushed ({reason}): "
               f"{n} executables, {nr} residual tensors dropped",
@@ -314,6 +318,7 @@ def take_residual(key: Tuple, dtype,
     array and a checkpoint-restored local [T])."""
     with _lock:
         r = _residuals.pop(key, None)
+    _sync_residual_ledger()
     if r is None \
             or not any(tuple(r.shape) == tuple(s) for s in shapes) \
             or str(r.dtype) != str(jnp.dtype(dtype)) \
@@ -326,6 +331,7 @@ def store_residuals(keys: Sequence[Tuple], arrays: Sequence) -> None:
     with _lock:
         for key, arr in zip(keys, arrays):
             _residuals[key] = arr
+    _sync_residual_ledger()
 
 
 def drop_residuals(keys: Sequence[Tuple]) -> None:
@@ -335,6 +341,31 @@ def drop_residuals(keys: Sequence[Tuple]) -> None:
     with _lock:
         for key in keys:
             _residuals.pop(key, None)
+    _sync_residual_ledger()
+
+
+def _sync_residual_ledger() -> None:
+    """hvd-mem: mirror the EF residual store's byte total into the
+    device-memory ledger (``megakernel.residuals``) — the store is the
+    one long-lived executor-owned buffer set, so the ledger carries its
+    absolute size rather than alloc/free deltas.  NOT gated on
+    telemetry enablement: a flush/drop landing while an A/B leg has
+    telemetry off must still clear the figure, or the ledger reports
+    phantom residual bytes forever after re-enable (the frees in
+    input.py/checkpoint.py are unconditional for the same reason);
+    the cost is one dict walk per residual transition, nowhere near a
+    hot path."""
+    with _lock:
+        arrays = list(_residuals.values())
+    total = 0
+    for v in arrays:
+        nb = getattr(v, "nbytes", None)
+        if nb:
+            try:
+                total += int(nb)
+            except (TypeError, ValueError):
+                pass
+    _mem.ledger.set("megakernel.residuals", total)
 
 
 def residual_count() -> int:
@@ -381,6 +412,7 @@ def load_compression_state(state: Dict[str, Dict[str, Any]]) -> None:
         _residuals.update(res)
         _ticks.clear()
         _ticks.update(ticks)
+    _sync_residual_ledger()
 
 
 def digest_of(spec: GroupSpec) -> Optional[str]:
@@ -1007,7 +1039,13 @@ def warm_start(mesh, directory: Optional[str] = None) -> int:
                 if spec in _compiled:
                     continue
             fn = _build(spec, mesh)
-            fn.lower(*_warm_avals(spec, mesh)).compile()
+            compiled = fn.lower(*_warm_avals(spec, mesh)).compile()
+            # hvd-mem: harvest compiled.memory_analysis() per warmed
+            # executable (where the backend implements it) — the
+            # static planner's per-mesh "compiled" section.
+            _mem_planner.record_compiled(
+                f"megakernel/{entry['op']}/{entry['variant']}"
+                f"/{entry.get('digest') or warmed}", compiled)
             _cache_insert(spec, fn, entry.get("digest"))
             warmed += 1
         except Exception:  # noqa: BLE001 — a stale entry must not
@@ -1074,6 +1112,12 @@ def wire_accounting(spec: GroupSpec) -> Tuple[int, int]:
     return logical, wire_b
 
 
+def _launch_name(spec: GroupSpec) -> str:
+    """Executable name for OOM forensics (cold/error paths only — the
+    steady-state launch never builds it)."""
+    return f"megakernel/{spec.op}/{spec.variant}x{len(spec.shapes)}"
+
+
 def launch(spec: GroupSpec, mesh, values: Sequence,
            digest_fn: Optional[Callable[[], str]] = None,
            donate_mask: Optional[Sequence[bool]] = None):
@@ -1088,7 +1132,32 @@ def launch(spec: GroupSpec, mesh, values: Sequence,
     fn, cold = executable(spec, mesh, digest_fn)
     mask = tuple(donate_mask) if donate_mask is not None else spec.donate
     logical_b, wire_b, dcn_b = wire_accounting_legs(spec)
-    trace_t0 = time.monotonic() if _trace.enabled() else 0.0
+    # hvd-mem: the launch's HBM footprint (contributions + outputs, the
+    # SAME byte model the planner predicts with) is accounted against
+    # the ledger for the dispatch's lifetime, and a RESOURCE_EXHAUSTED
+    # dumps the flight ring naming this executable and the top ledger
+    # categories.  The byte arithmetic only runs when something
+    # consumes it (ledger, trace span, simulated capacity), so the
+    # telemetry-off A/B leg measures a true zero-accounting path and
+    # the ≤5 % overhead gate covers the accounting it claims to.
+    mem_on = _mem.enabled()
+    trace_on = _trace.enabled()
+    cap = _oom.simulated_capacity()
+    fusion_b = (_mem_planner.fusion_group_bytes(
+        spec.shapes, spec.dtype, len(spec.mesh_key), spec.variant)
+        if (mem_on or trace_on or cap is not None) else 0)
+    if cap is not None:
+        # The capacity knob is per-DEVICE HBM: project the per-device
+        # footprint (one payload of inputs + one of outputs per
+        # device, identical across variants), not the 2·world global
+        # figure the ledger/planner consistency contract shares — a
+        # world>1 job with a correctly pinned per-rank capacity must
+        # not raise fake OOMs (docs/memory.md).
+        _oom.check_simulated(
+            lambda: _launch_name(spec),
+            _mem_planner.fusion_group_device_bytes(spec.shapes,
+                                                   spec.dtype))
+    trace_t0 = time.monotonic() if trace_on else 0.0
 
     def dispatch():
         # XLA compiles on the cold executable's FIRST dispatch; time
@@ -1103,42 +1172,55 @@ def launch(spec: GroupSpec, mesh, values: Sequence,
         return out
 
     counting = _xla_dispatch.counting_enabled()
-    if counting:
-        probes = [weakref.ref(v)
-                  for v, d in zip(values, mask) if d]
-        with _xla_dispatch.record() as scope:
+    if mem_on:
+        _mem.ledger.alloc("megakernel.fusion", fusion_b)
+    try:
+        if counting:
+            probes = [weakref.ref(v)
+                      for v, d in zip(values, mask) if d]
+            with _xla_dispatch.record() as scope:
+                outs = dispatch()
+            with _lock:
+                stats.launches += 1
+                stats.launch_dispatches += scope.count
+                stats.donated_inputs += sum(mask)
+                stats.logical_bytes += logical_b
+                stats.wire_bytes += wire_b
+                if spec.hier is not None:
+                    stats.hier_launches += 1
+                if _needs_quant_build(spec):
+                    stats.quant_launches += 1
+                last_donated[:] = probes
+        else:
             outs = dispatch()
-        with _lock:
-            stats.launches += 1
-            stats.launch_dispatches += scope.count
-            stats.donated_inputs += sum(mask)
-            stats.logical_bytes += logical_b
-            stats.wire_bytes += wire_b
-            if spec.hier is not None:
-                stats.hier_launches += 1
-            if _needs_quant_build(spec):
-                stats.quant_launches += 1
-            last_donated[:] = probes
-    else:
-        outs = dispatch()
-        with _lock:
-            stats.launches += 1
-            stats.donated_inputs += sum(mask)
-            stats.logical_bytes += logical_b
-            stats.wire_bytes += wire_b
-            if spec.hier is not None:
-                stats.hier_launches += 1
-            if _needs_quant_build(spec):
-                stats.quant_launches += 1
+            with _lock:
+                stats.launches += 1
+                stats.donated_inputs += sum(mask)
+                stats.logical_bytes += logical_b
+                stats.wire_bytes += wire_b
+                if spec.hier is not None:
+                    stats.hier_launches += 1
+                if _needs_quant_build(spec):
+                    stats.quant_launches += 1
+    except Exception as e:  # noqa: BLE001 — re-raised: forensics only
+        if _oom.is_resource_exhausted(e):
+            _oom.oom_event(_launch_name(spec), e, fusion_b or None)
+        raise
+    finally:
+        if mem_on:
+            _mem.ledger.free("megakernel.fusion", fusion_b)
     if _telemetry.enabled():
         _M_WIRE_BYTES.observe(wire_b)
     if _trace.enabled():
         # hvd-trace launch span: the compiled collective itself.  The
         # wire-byte legs let the analyzer split a hierarchical launch's
-        # time into its ICI ("collective") and DCN shares.
+        # time into its ICI ("collective") and DCN shares; mem_bytes
+        # mirrors the ledger charge so the fleet trace shows each
+        # launch's HBM footprint next to its wall time (hvd-mem).
         _trace.span(f"megakernel/{spec.op}", "collective", trace_t0,
                     time.monotonic(),
                     args={"groups": len(spec.shapes),
                           "hier": spec.hier is not None,
-                          "wire_bytes": wire_b, "dcn_bytes": dcn_b})
+                          "wire_bytes": wire_b, "dcn_bytes": dcn_b,
+                          "mem_bytes": fusion_b})
     return outs

@@ -57,6 +57,7 @@ from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
+from ..memory import ledger as _mem
 from ..telemetry import flight as _flight
 
 _M_STALL = _telemetry.histogram(
@@ -69,6 +70,18 @@ _M_DEPTH = _telemetry.gauge(
 
 # Queue sentinels (identity-compared).
 _END = object()
+
+
+class _Staged:
+    """One staged device batch plus its ledger charge.  A wrapper
+    class, not a tuple — user batches may themselves be tuples, and the
+    consumer must distinguish them from the bookkeeping by type."""
+
+    __slots__ = ("batch", "nbytes")
+
+    def __init__(self, batch, nbytes: int) -> None:
+        self.batch = batch
+        self.nbytes = nbytes
 
 
 def _shardings_for(batch: Any, mesh, sharding) -> Any:
@@ -140,7 +153,14 @@ class PrefetchIterator:
                 staged = device_put_batch(host_batch, self._mesh,
                                           self._sharding)
                 _M_STAGED.inc()
-                if not self._put(staged):
+                # hvd-mem: a staged batch is framework-held HBM until
+                # the consumer takes it — charge the ledger for its
+                # queue residency (released at __next__/close).
+                nb = _mem.tree_nbytes(staged) if _mem.enabled() else 0
+                if nb:
+                    _mem.ledger.alloc("input.prefetch", nb)
+                if not self._put(_Staged(staged, nb)):
+                    _mem.ledger.free("input.prefetch", nb)
                     return
         except BaseException as e:  # noqa: BLE001 — carried to consumer
             _telemetry.prefetch_error_event(
@@ -206,7 +226,9 @@ class PrefetchIterator:
             # Re-raise ON the consumer thread with the stager-side
             # traceback intact (the exception object carries it).
             raise item
-        return item
+        if item.nbytes:
+            _mem.ledger.free("input.prefetch", item.nbytes)
+        return item.batch
 
     def close(self) -> None:
         """Stop the stager and join it.  Safe mid-epoch with a full
@@ -214,10 +236,14 @@ class PrefetchIterator:
         call twice, safe from ``__del__``."""
         self._stop.set()
         # Unblock a stager parked in put() by draining; it re-checks the
-        # stop flag within its put timeout either way.
+        # stop flag within its put timeout either way.  Drained staged
+        # batches release their ledger charge — a mid-epoch shutdown
+        # must not read as a prefetch leak.
         try:
             while True:
-                self._q.get_nowait()
+                item = self._q.get_nowait()
+                if isinstance(item, _Staged) and item.nbytes:
+                    _mem.ledger.free("input.prefetch", item.nbytes)
         except queue.Empty:
             pass
         if self._thread.is_alive():

@@ -71,6 +71,7 @@ whole-graph backward (the same ULP story as
 from __future__ import annotations
 
 import collections
+import math
 import os
 import time
 from dataclasses import dataclass, field
@@ -86,6 +87,9 @@ from ..core import compat as _compat
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
 from ..core.topology import PIPE_AXIS
+from ..memory import ledger as _mem
+from ..memory import oom as _oom
+from ..memory import planner as _mem_planner
 
 try:
     import optax
@@ -108,6 +112,12 @@ _M_BUBBLE = _telemetry.histogram(
 _M_INFLIGHT = _telemetry.gauge(
     "pipeline.inflight_activations",
     "peak stage-boundary activations held live by the last schedule")
+# hvd-mem: the figure that actually bounds a launch — BYTES, not tensor
+# count (a carry count of 9 says nothing about whether 9 carries fit).
+_M_INFLIGHT_BYTES = _telemetry.gauge(
+    "pipeline.inflight_activation_bytes",
+    "peak stage-boundary activation bytes held live by the last "
+    "schedule (the 1F1B-vs-GPipe memory bound, in bytes)")
 
 
 def _nearest_divisors(n: int, m: int) -> Tuple[int, int]:
@@ -323,6 +333,59 @@ def schedule_plan(n_stages: int, num_microbatches: int,
 # The MPMD pipeline train step
 # ---------------------------------------------------------------------------
 
+class _AotProgram:
+    """AOT-compile-on-first-dispatch wrapper around one jitted stage
+    program (hvd-mem): the first call lowers + compiles with the
+    concrete arguments — the SAME executable ``jit`` would have built,
+    one compile total — then harvests ``compiled.memory_analysis()``
+    into the planner's per-mesh table (where the backend implements the
+    query), and every dispatch runs inside the OOM guard naming this
+    executable, so a pipeline-stage RESOURCE_EXHAUSTED dumps forensics
+    instead of a bare traceback.  A shape change (or any non-OOM
+    compiled-call failure) falls back to the jit wrapper, which
+    recompiles transparently — semantics identical to plain jit."""
+
+    __slots__ = ("name", "_fn", "_compiled")
+
+    def __init__(self, name: str, fn) -> None:
+        self.name = name
+        self._fn = fn
+        self._compiled = None
+
+    def __call__(self, *args):
+        with _oom.guard(self.name):
+            if self._compiled is None:
+                try:
+                    compiled = self._fn.lower(*args).compile()
+                    _mem_planner.record_compiled(self.name, compiled)
+                    self._compiled = compiled
+                except Exception:  # noqa: BLE001 — AOT lowering is an
+                    self._compiled = False  # optimization, jit is the
+                    # semantic baseline
+            if self._compiled:
+                try:
+                    return self._compiled(*args)
+                except Exception as e:  # noqa: BLE001 — see below
+                    if _oom.is_resource_exhausted(e):
+                        raise
+                    # A RUNTIME failure after XLA consumed the donated
+                    # inputs must surface, not retry: the jit fallback
+                    # would read deleted buffers and mask the original
+                    # error (the ops/collective.py consumed-check
+                    # convention).  Shape mismatches fail BEFORE
+                    # dispatch — inputs intact — and hand over to jit
+                    # PERMANENTLY: jit's own cache then serves every
+                    # recurring shape, where re-arming the AOT path
+                    # would pay a fresh XLA compile per A/B shape
+                    # alternation (e.g. an epoch-end partial
+                    # microbatch) that plain jit never pays.
+                    if any(isinstance(a, jax.Array) and a.is_deleted()
+                           for a in jax.tree_util.tree_leaves(args)):
+                        raise
+                    self._compiled = False
+            return self._fn(*args)
+
+
 class _PipelineStep:
     """Host-scheduled MPMD pipeline train step: per-stage compiled
     forward/backward microbatch executables dispatched in
@@ -422,8 +485,60 @@ class _PipelineStep:
             except Exception:  # noqa: BLE001 — size-check contexts
                 thr = _fusion_threshold_bytes()
         self._bucket_plan = _build_plan(seg_avals, int(thr))
+        self._preflight(params, batch)
         self._build_programs()
         self._apply = self._build_apply()
+
+    def _preflight(self, params, batch) -> None:
+        """hvd-mem pre-flight (docs/memory.md): size the schedule's
+        peak carries via ``jax.eval_shape`` over the stage chain — no
+        compute, no compile — and WARN before the first dispatch when
+        activations + stage params + gradient accumulators exceed the
+        advertised per-rank HBM capacity.  Best-effort: a stage whose
+        body resists shape abstraction skips the check, never the
+        build."""
+        if _oom.advertised_capacity() is None:
+            return
+        try:
+            m = self._m
+
+            def sds_nbytes(tree) -> int:
+                total = 0
+                for leaf in jax.tree_util.tree_leaves(tree):
+                    total += int(jnp.dtype(leaf.dtype).itemsize) * int(
+                        math.prod(leaf.shape) or 1)
+                return total
+
+            def mb(x):
+                return jax.ShapeDtypeStruct(
+                    (int(x.shape[0]) // m,) + tuple(x.shape[1:]),
+                    x.dtype)
+
+            mb_batch = jax.tree_util.tree_map(mb, batch)
+            stages = self._chain.stages
+            carry = jax.eval_shape(
+                lambda p, b: stages[0](p, None, b), params[0], mb_batch)
+            max_carry = sds_nbytes(carry)
+            for k in range(1, self._S - 1):
+                carry = jax.eval_shape(
+                    lambda p, c, b, k=k: stages[k](p, c, b),
+                    params[k], carry, mb_batch)
+                max_carry = max(max_carry, sds_nbytes(carry))
+            world = int(self._mesh.devices.size)
+            pbytes = sum(_mem.tree_nbytes(p) for p in params)
+            # Per-DEVICE figure vs the per-device capacity: carries
+            # and gradient accumulators shard over the replica axis
+            # (global/world per device); params are replicated (full
+            # copy per device).
+            predicted = (self._plan.peak_activations * max_carry
+                         // max(1, world) + 2 * pbytes)
+            _oom.preflight_warn(
+                predicted, "make_pipeline_train_step",
+                f"{self._plan.peak_activations} peak carries x "
+                f"{max_carry} B / {world} devices + stage params + "
+                f"accumulators ({self._plan.schedule}, m={m})")
+        except Exception:  # noqa: BLE001 — pre-flight must never
+            pass           # break a build eval_shape cannot model
 
     def _build_programs(self) -> None:
         stages = self._chain.stages
@@ -459,16 +574,16 @@ class _PipelineStep:
             return jax.lax.pmean(loss, REPLICA_AXIS)
 
         self._fwd: List[Callable] = [None] * S
-        self._fwd[0] = jax.jit(sm(fwd0, mesh=mesh,
-                                  in_specs=(P(), R, P()), out_specs=R,
-                                  check_vma=False))
+        self._fwd[0] = _AotProgram("pipeline/F0", jax.jit(
+            sm(fwd0, mesh=mesh, in_specs=(P(), R, P()), out_specs=R,
+               check_vma=False)))
         for k in range(1, S - 1):
-            self._fwd[k] = jax.jit(sm(make_fwd(k), mesh=mesh,
-                                      in_specs=(P(), R, R, P()),
-                                      out_specs=R, check_vma=False))
-        self._fwd[S - 1] = jax.jit(sm(fwd_last, mesh=mesh,
-                                      in_specs=(P(), R, R, P()),
-                                      out_specs=P(), check_vma=False))
+            self._fwd[k] = _AotProgram(f"pipeline/F{k}", jax.jit(
+                sm(make_fwd(k), mesh=mesh, in_specs=(P(), R, R, P()),
+                   out_specs=R, check_vma=False)))
+        self._fwd[S - 1] = _AotProgram(f"pipeline/F{S - 1}", jax.jit(
+            sm(fwd_last, mesh=mesh, in_specs=(P(), R, R, P()),
+               out_specs=P(), check_vma=False)))
 
         # Backward programs: jax.vjp with in-segment rematerialization
         # (the overlap substrate), gradient ACCUMULATION folded in (the
@@ -512,26 +627,31 @@ class _PipelineStep:
                 return g
             return bwd
 
-        def jit_b(fn, in_specs, out_specs, donate):
-            return jax.jit(sm(fn, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_vma=False),
-                           donate_argnums=donate)
+        def jit_b(name, fn, in_specs, out_specs, donate):
+            return _AotProgram(name, jax.jit(
+                sm(fn, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_vma=False),
+                donate_argnums=donate))
 
         self._bwd: List[Callable] = [None] * S
         self._bwd_acc: List[Callable] = [None] * S
-        self._bwd[S - 1] = jit_b(make_bwd_last(False),
+        self._bwd[S - 1] = jit_b(f"pipeline/B{S - 1}",
+                                 make_bwd_last(False),
                                  (P(), R, R, P()), (R, R), (1,))
-        self._bwd_acc[S - 1] = jit_b(make_bwd_last(True),
+        self._bwd_acc[S - 1] = jit_b(f"pipeline/B{S - 1}acc",
+                                     make_bwd_last(True),
                                      (P(), R, R, P(), R), (R, R), (1, 4))
         for k in range(1, S - 1):
-            self._bwd[k] = jit_b(make_bwd_mid(k, False),
+            self._bwd[k] = jit_b(f"pipeline/B{k}",
+                                 make_bwd_mid(k, False),
                                  (P(), R, R, P(), R), (R, R), (1, 4))
-            self._bwd_acc[k] = jit_b(make_bwd_mid(k, True),
+            self._bwd_acc[k] = jit_b(f"pipeline/B{k}acc",
+                                     make_bwd_mid(k, True),
                                      (P(), R, R, P(), R, R), (R, R),
                                      (1, 4, 5))
-        self._bwd[0] = jit_b(make_bwd_first(False),
+        self._bwd[0] = jit_b("pipeline/B0", make_bwd_first(False),
                              (P(), R, P(), R), R, (3,))
-        self._bwd_acc[0] = jit_b(make_bwd_first(True),
+        self._bwd_acc[0] = jit_b("pipeline/B0acc", make_bwd_first(True),
                                  (P(), R, P(), R, R), R, (3, 4))
 
         self._loss_mean = jax.jit(lambda xs: jnp.mean(jnp.stack(xs)))
@@ -585,11 +705,36 @@ class _PipelineStep:
         window = _InflightWindow(_max_inflight()) if self._cpu_mesh \
             else None
         carries = {}          # (stage, mb) -> boundary activation
+        carry_nb = {}         # (stage, mb) -> ledger bytes (hvd-mem)
         cts = {}              # (stage, mb) -> cotangent from stage's B
         accs: List = [None] * S
         losses: List = [None] * m
         handles: List[Optional[int]] = [None] * self._bucket_plan.n_leaves
         live = peak = 0
+        live_b = peak_b = 0
+        mem_on = _mem.enabled()
+
+        def born(key, out):
+            # A carry was born: count it AND charge its bytes to the
+            # ledger (pipeline.activations) — the figure that actually
+            # bounds the schedule (peak carries x carry size).
+            nonlocal live_b, peak_b
+            carries[key] = out
+            if mem_on:
+                nb = _mem.tree_nbytes(out)
+                carry_nb[key] = nb
+                live_b += nb
+                peak_b = max(peak_b, live_b)
+                _mem.ledger.alloc("pipeline.activations", nb)
+
+        def consumed(key):
+            nonlocal live_b
+            out = carries.pop(key)
+            nb = carry_nb.pop(key, 0)
+            if nb:
+                live_b -= nb
+                _mem.ledger.free("pipeline.activations", nb)
+            return out
 
         for tick in plan.ticks:
             for a in tick:
@@ -598,14 +743,15 @@ class _PipelineStep:
                 if a.phase == "F":
                     if s == 0:
                         out = self._fwd[0](params[0], batch, i)
-                        carries[(0, a.mb)] = out
+                        born((0, a.mb), out)
                         live += 1
                     elif s == S - 1:
                         out = losses[a.mb] = self._fwd[s](
                             params[s], carries[(s - 1, a.mb)], batch, i)
                     else:
-                        out = carries[(s, a.mb)] = self._fwd[s](
+                        out = self._fwd[s](
                             params[s], carries[(s - 1, a.mb)], batch, i)
+                        born((s, a.mb), out)
                         live += 1
                     peak = max(peak, live)
                 else:
@@ -613,7 +759,7 @@ class _PipelineStep:
                         else self._bwd[s]
                     extra = (accs[s],) if accs[s] is not None else ()
                     if s == S - 1:
-                        out = prog(params[s], carries.pop((s - 1, a.mb)),
+                        out = prog(params[s], consumed((s - 1, a.mb)),
                                    batch, i, *extra)
                         accs[s], cts[(s, a.mb)] = out
                         live -= 1
@@ -621,7 +767,7 @@ class _PipelineStep:
                         out = accs[0] = prog(params[0], batch, i,
                                              cts.pop((1, a.mb)), *extra)
                     else:
-                        out = prog(params[s], carries.pop((s - 1, a.mb)),
+                        out = prog(params[s], consumed((s - 1, a.mb)),
                                    batch, i, cts.pop((s + 1, a.mb)),
                                    *extra)
                         accs[s], cts[(s, a.mb)] = out
@@ -665,6 +811,9 @@ class _PipelineStep:
             _M_BUBBLE.observe(time.perf_counter() - t0)
             _M_MICROBATCHES.inc(m)
             _M_INFLIGHT.set(peak)
+            _M_INFLIGHT_BYTES.set(peak_b)
+        if mem_on:
+            _mem.ledger.note_step()
         red_tree = jax.tree_util.tree_unflatten(self._treedef, reduced)
         loss = self._loss_mean(losses)
         new_params, opt_state = self._apply(red_tree, opt_state, params)

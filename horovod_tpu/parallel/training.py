@@ -32,6 +32,8 @@ from .. import trace as _trace
 from ..core import compat as _compat
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
+from ..memory import ledger as _mem
+from ..memory import oom as _oom
 from .data import DistributedOptimizer, allreduce_gradients
 
 try:
@@ -177,26 +179,52 @@ class _ThrottledStep:
 
 
 class _TracedStep:
-    """hvd-trace step counter: advance the propagated step id once per
-    call (trace/__init__.py), so every span this step's collectives /
-    prefetch waits / checkpoint writes produce carries the step that
-    owns it — the key the fleet-trace analyzer groups by.  Arithmetic
-    is untouched; the jit surface passes through like
+    """Per-step bookkeeping wrapper: advance the hvd-trace step id
+    (trace/__init__.py) so every span carries the step that owns it,
+    close the hvd-mem ledger's step window (the per-step high-watermark
+    gauge), and — first call only — pre-flight-warn when the working
+    set this step implies (params + gradients + optimizer slots +
+    batch) exceeds the advertised HBM capacity (memory/oom.py).
+    Arithmetic is untouched; the jit surface passes through like
     :class:`_ThrottledStep`'s."""
 
     def __init__(self, step_fn):
         self._step_fn = step_fn
+        self._preflighted = False
+
+    def _preflight(self, args) -> None:
+        self._preflighted = True
+        if _oom.advertised_capacity() is None or not args:
+            return
+        try:
+            params_b = _mem.tree_nbytes(args[0])
+            batch_b = _mem.tree_nbytes(args[-1]) if len(args) > 1 else 0
+            # params + grads + two optimizer slots (the adam-shaped
+            # upper bound) + the batch: the static working-set model
+            # of docs/memory.md.
+            _oom.preflight_warn(
+                4 * params_b + batch_b, "make_train_step",
+                f"params {params_b} B x (1 grad + 2 opt slots) + "
+                f"batch {batch_b} B")
+        except Exception:  # noqa: BLE001 — sizing is observability
+            pass
 
     def __call__(self, *args, **kw):
-        _trace.on_step()
-        return self._step_fn(*args, **kw)
+        if _trace.trace_enabled_env():
+            _trace.on_step()
+        if not self._preflighted:
+            self._preflight(args)
+        out = self._step_fn(*args, **kw)
+        if _mem.enabled():
+            _mem.ledger.note_step()
+        return out
 
     def __getattr__(self, name):
         return getattr(self._step_fn, name)
 
 
 def _traced(step_fn):
-    return _TracedStep(step_fn) if _trace.trace_enabled_env() else step_fn
+    return _TracedStep(step_fn)
 
 
 def _make_step(loss_fn, optimizer, mesh, average, fusion_threshold,

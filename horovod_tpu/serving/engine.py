@@ -51,6 +51,8 @@ from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..analysis import lockorder as _lockorder
 from ..core.topology import MODEL_AXIS
+from ..memory import oom as _oom
+from ..memory import planner as _mem_planner
 from ..telemetry import flight as _flight
 from ..models import transformer as _transformer
 from ..ops import megakernel as _megakernel
@@ -168,11 +170,17 @@ class InferenceEngine:
         self._ready = False
 
     def health(self) -> Tuple[bool, dict]:
-        """Exporter health contributor (exporter.register_health)."""
+        """Exporter health contributor (exporter.register_health).
+        ``kv_free_pages`` is the hvd-mem satellite: the router tier
+        needs admission HEADROOM (can this replica take a long prompt)
+        next to queue depth — occupancy alone says nothing about how
+        full the occupied slots' page budgets are."""
         return self._ready, {
             "ready": self._ready,
             "queue_depth": self.scheduler.queue_depth(),
             "batch_occupancy": self.scheduler.occupancy(),
+            "kv_free_pages": self.cache.free_pages(),
+            "kv_total_pages": self.cache.total_pages,
             "slots": self.max_slots,
             "executables": len(self._exec),
         }
@@ -216,6 +224,27 @@ class InferenceEngine:
         self._decode_exec()  # readiness == "can decode", manifest or not
         if warmed:
             _M_WARM.inc(warmed)
+        # hvd-mem pre-flight: the engine's PER-DEVICE working set (one
+        # KV shard — global/tp when the head axis is sharded — plus
+        # one copy of the replicated params) against the per-device
+        # HBM capacity — warned HERE, before the load balancer routes
+        # traffic at a replica that cannot actually hold its cache.
+        # Per-device, not global and not a per-process sum: either of
+        # those cries wolf on exactly the large sharded multi-device
+        # deployments this check targets (docs/memory.md).
+        try:
+            from ..memory import ledger as _mem_ledger
+
+            per_device = (_mem_ledger.device_nbytes(self.cache.k_pages)
+                          + _mem_ledger.device_nbytes(
+                              self.cache.v_pages)
+                          + sum(_mem_ledger.device_nbytes(x) for x in
+                                jax.tree_util.tree_leaves(self.params)))
+            _oom.preflight_warn(per_device, "serving.warm_start",
+                                "KV shard + replicated params "
+                                "(per-device bytes)")
+        except Exception:  # noqa: BLE001 — sizing is observability
+            pass
         self._ready = True
         return warmed
 
@@ -261,6 +290,11 @@ class InferenceEngine:
                                            sharding=x.sharding), args)
         jfn = jax.jit(fn, donate_argnums=(1, 2))
         compiled = jfn.lower(*avals).compile()
+        # hvd-mem: harvest compiled.memory_analysis() per serving
+        # executable (prefill buckets + decode) into the planner's
+        # per-mesh table, where the backend implements the query.
+        _mem_planner.record_compiled(
+            "serving/" + "/".join(str(k) for k in key), compiled)
         self._exec[key] = compiled
         self._record(key[0], key[1] if len(key) > 1 else None)
         return compiled
@@ -486,10 +520,12 @@ class InferenceEngine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = prompt
         compiled = self._prefill_exec(bucket)
-        last, kp, vp = compiled(
-            self.params, self.cache.k_pages, self.cache.v_pages,
-            self._rep(self.cache.table_row(slot)),
-            self._rep(np.asarray([n], np.int32)), self._rep(tokens))
+        with _oom.guard(f"serving/prefill/{bucket}"):
+            last, kp, vp = compiled(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                self._rep(self.cache.table_row(slot)),
+                self._rep(np.asarray([n], np.int32)),
+                self._rep(tokens))
         self.cache.replace_pages(kp, vp)
         _M_PREFILLS.inc()
         return np.asarray(last)
@@ -503,9 +539,10 @@ class InferenceEngine:
         for slot, _ in active:
             tokens[slot] = self._last_token[slot]
         compiled = self._decode_exec()
-        logits, kp, vp = compiled(
-            self.params, self.cache.k_pages, self.cache.v_pages,
-            table, lengths, self._rep(tokens))
+        with _oom.guard("serving/decode"):
+            logits, kp, vp = compiled(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                table, lengths, self._rep(tokens))
         self.cache.replace_pages(kp, vp)
         logits_np = np.asarray(logits)
         fed = {}
@@ -585,9 +622,10 @@ class InferenceEngine:
             for slot in decode:
                 tokens[slot] = self._last_token[slot]
             compiled = self._decode_exec()
-            _, kp, vp = compiled(
-                self.params, self.cache.k_pages, self.cache.v_pages,
-                table, lengths, self._rep(tokens))
+            with _oom.guard("serving/decode"):
+                _, kp, vp = compiled(
+                    self.params, self.cache.k_pages, self.cache.v_pages,
+                    table, lengths, self._rep(tokens))
             self.cache.replace_pages(kp, vp)
             fed = self._bcast(None)
             if fed.get("abort"):

@@ -30,6 +30,7 @@ no code change here.
 
 from __future__ import annotations
 
+import weakref
 from typing import List, Optional, Tuple
 
 import jax
@@ -38,8 +39,23 @@ import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
 from ..core.topology import MODEL_AXIS
+from ..memory import ledger as _mem
+
+# hvd-mem satellite: free-page headroom next to serving.batch_occupancy
+# — the ROADMAP-item-2 router tier dispatches on how much KV room a
+# replica has LEFT, not just how deep its queue is.  Push-fed (set
+# under the cache lock at every page-management transition), so it is
+# current in /healthz, the FRAME_METRICS fleet pull and every flight
+# dump's tail.
+_M_KV_FREE = _telemetry.gauge(
+    "serving.kv_free_pages",
+    "KV pages on the free list (admission headroom)")
+_M_KV_TOTAL = _telemetry.gauge(
+    "serving.kv_total_pages",
+    "allocatable KV pages (capacity; excludes the trash page)")
 
 
 class PagedKVCache:
@@ -86,6 +102,22 @@ class PagedKVCache:
         # guarded_by: _lock
         self._table = np.zeros((max_slots, pages_per_slot), np.int32)
         self._lengths = np.full((max_slots,), -1, np.int32)
+        _M_KV_TOTAL.set(self.total_pages)
+        _M_KV_FREE.set(len(self._free))
+        # hvd-mem: the page arrays are THE serving framework buffer —
+        # account the bytes RESIDENT on this process (addressable
+        # shards: a tp-sharded store holds global/tp per rank) for the
+        # store's lifetime (keyed, released by gc: replace_pages swaps
+        # same-shape donated outputs, so the figure is constant while
+        # the engine lives).
+        self._ledger_key = id(self)
+        if _mem.enabled():
+            _mem.ledger.alloc("serving.kv_pages",
+                              _mem.resident_nbytes(k)
+                              + _mem.resident_nbytes(v),
+                              key=self._ledger_key)
+        weakref.finalize(self, _mem.ledger.free, "serving.kv_pages",
+                         key=self._ledger_key)
 
     # -- sharding ----------------------------------------------------------
     def page_sharding(self) -> Optional[NamedSharding]:
@@ -142,6 +174,7 @@ class PagedKVCache:
                         "— sizing guarantees this cannot happen while "
                         "every slot stays within pages_per_slot")
                 self._table[slot, p] = self._free.pop(0)
+        _M_KV_FREE.set(len(self._free))
 
     def advance(self, slot: int) -> int:
         """One decoded token was written at the current length; map the
@@ -168,10 +201,17 @@ class PagedKVCache:
                     self._free.append(page)
             self._table[slot] = 0
             self._lengths[slot] = -1
+            _M_KV_FREE.set(len(self._free))
 
     def length(self, slot: int) -> int:
         with self._lock:
             return int(self._lengths[slot])
+
+    @property
+    def total_pages(self) -> int:
+        """Allocatable pages (the trash page is never handed out) —
+        the ONE place the reserved-page invariant is priced in."""
+        return self.n_pages - 1
 
     def free_pages(self) -> int:
         with self._lock:

@@ -37,17 +37,41 @@ _default = MetricsRegistry()
 
 # Metric-name prefixes worth carrying in a flight dump's compact tail:
 # the control-plane, data-plane and host counters that contextualize a
-# stall (docs/metrics.md "Dump format").
+# stall, PLUS (hvd-mem satellite) the gauge families — queue depths,
+# occupancy, checkpoint backlog, memory watermarks — so every stall,
+# dead-peer and OOM dump is self-contained forensics (docs/metrics.md
+# "Dump format").  Push-fed gauges (serving.queue_depth,
+# input.prefetch_queue_depth, checkpoint.pending, serving.kv_free_pages,
+# memory.step_watermark_bytes) are current at dump time; collector-fed
+# gauges carry their last-snapshot value (collectors still don't run
+# here — a dump may fire from under runtime locks).
 _FLIGHT_TAIL_PREFIXES = ("collective.", "transport.", "host.",
-                        "events.", "input.", "trace.", "chaos.")
+                        "events.", "input.", "trace.", "chaos.",
+                        "serving.", "pipeline.", "overlap.",
+                        "checkpoint.", "handles.", "memory.")
+
+# Extra tail providers (keyed, replace-on-reregister): subsystems whose
+# dump-time truth lives OUTSIDE the registry (the hvd-mem ledger) merge
+# a flat name->value dict into every tail.  Providers must be cheap and
+# take only leaf locks — dumps fire from failure paths.
+_extra_tails: Dict[str, object] = {}
+
+
+def register_flight_tail(key: str, fn) -> None:
+    _extra_tails[key] = fn
+
+
+def unregister_flight_tail(key: str) -> None:
+    _extra_tails.pop(key, None)
 
 
 def _flight_metrics_tail() -> Dict[str, object]:
     """The compact snapshot appended to every flight dump (satellite of
-    hvd-trace): counters/gauges as bare values, histograms as
-    count+sum.  Collectors are skipped — they read runtime structures
-    and a dump may fire from under runtime locks; the striped leaves
-    below are lock-free."""
+    hvd-trace, extended by hvd-mem): counters AND gauges as bare
+    values, histograms as count+sum.  Collectors are skipped — they
+    read runtime structures and a dump may fire from under runtime
+    locks; the striped leaves below are lock-free and the extra tail
+    providers take only leaf locks."""
     out: Dict[str, object] = {}
     for name, m in _default.snapshot(run_collectors=False).items():
         if not name.startswith(_FLIGHT_TAIL_PREFIXES):
@@ -57,6 +81,11 @@ def _flight_metrics_tail() -> Dict[str, object]:
                          "sum": m.get("sum", 0)}
         else:
             out[name] = m.get("value", 0)
+    for fn in list(_extra_tails.values()):
+        try:
+            out.update(fn())
+        except Exception:  # noqa: BLE001 — the dump must not mask
+            pass           # the original failure
     return out
 
 

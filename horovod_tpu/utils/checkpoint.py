@@ -50,6 +50,7 @@ from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..analysis import lockorder as _lockorder
 from ..core import state as _state
+from ..memory import ledger as _mem
 from ..parallel.data import broadcast_parameters
 from ..telemetry import flight as _flight
 from .retry import BackoffPolicy, retry_call
@@ -187,6 +188,15 @@ class _Writer:
 
     def submit(self, handle: CheckpointWrite, host_tree: Any,
                step: Optional[int]) -> None:
+        # hvd-mem: the host snapshot is framework-held memory until the
+        # background write publishes it — charged per handle, released
+        # in the writer's finally (success or failure alike).
+        if _mem.enabled():
+            handle._mem_bytes = _mem.tree_nbytes(host_tree)
+            if handle._mem_bytes:
+                _mem.ledger.alloc("checkpoint.snapshots",
+                                  handle._mem_bytes)
+
         def publish() -> None:
             from flax import serialization
 
@@ -234,6 +244,9 @@ class _Writer:
                                 time.monotonic(),
                                 args={"path": os.path.basename(
                                     handle.path)})
+                nb = getattr(handle, "_mem_bytes", 0)
+                if nb:
+                    _mem.ledger.free("checkpoint.snapshots", nb)
                 with self._lock:
                     self._pending -= 1
                     _M_PENDING.set(self._pending)

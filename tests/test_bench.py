@@ -290,6 +290,37 @@ def test_bench_pipeline_mode_contract_and_identity():
     assert payload["buckets"] >= plan["n_stages"]
 
 
+def test_bench_memory_mode_contract_and_gates():
+    """`--mode memory` (this round): the hvd-mem microbench emits one
+    contract JSON line and must clear its deterministic gates — the
+    planner's framework-bytes prediction within ±15 % of the measured
+    ledger high-watermark on both legs, byte-identical plans for
+    identical configs, and the seeded RESOURCE_EXHAUSTED producing a
+    forensic dump naming the executable and ≥3 ledger categories."""
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "memory", "--check-memory-plan", "15"],
+        env=env, cwd=REPO, capture_output=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    lines = [ln for ln in proc.stdout.decode().splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1, proc.stdout.decode()
+    payload = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "dataplane", "pipeline",
+                "plan_deterministic", "oom_dump",
+                "ledger_overhead_pct"):
+        assert key in payload, payload
+    assert payload["metric"] == "memory_plan_prediction_error_pct"
+    for leg in ("dataplane", "pipeline"):
+        err = payload[leg]["prediction_error_pct"]
+        assert err is not None and err <= 15.0, payload
+    assert payload["plan_deterministic"] is True
+    oom = payload["oom_dump"]
+    assert oom["ok"] is True and oom["executable"], payload
+    assert len(oom["top_categories"]) >= 3, payload
+
+
 @pytest.mark.slow
 def test_bench_failure_still_emits_contract_json():
     """A dead backend: the probe retries with backoff inside the budget
@@ -309,8 +340,9 @@ def test_bench_failure_still_emits_contract_json():
     assert "error" in payload
     # The CPU-only microbench sections ride the failure JSON too —
     # a dead tunnel can zero none of them (incl. this round's
-    # pipeline section).
+    # memory section).
     assert "pipeline" in payload and "overlap" in payload, payload
+    assert "memory" in payload, payload
     # The probe must have retried (>1 probe event) before giving up.
     probe_events = [e for e in payload["attempt_log"]
                     if e["event"] == "probe_fail"]

@@ -268,6 +268,32 @@ def test_np3_tree_direct_leaves_fanout8():
     assert len(set(results.values())) == 1
 
 
+def test_np3_tree_memory_gauges_aggregate_exactly():
+    """hvd-mem satellite: the np=3 FRAME_METRICS_TREE pull must carry
+    the memory gauge family from EVERY rank through the interior's
+    merge, with fleet min/max/mean exact.  Each cp rank seeds a
+    rank-keyed ledger entry ((rank+1) MiB); the controller asserts the
+    aggregated gauge per-rank values and min/max/mean bit-for-bit
+    (chaos.matrix._check_mem_gauges _diags on any mismatch) and prints
+    the CHAOS_MEMGAUGES marker only when exact."""
+    results, out = _run_cp_fleet({
+        "HVD_TPU_CHAOS_CP_STEPS": "12",
+        "HVD_TPU_TREE_PORT_BASE": str(_free_port()),
+        "HVD_TPU_TREE": "on", "HVD_TPU_TREE_FANOUT": "1"})
+    assert len(set(results.values())) == 1
+    assert "CHAOS_MEMGAUGES ranks=3 ok" in out
+
+
+def test_np3_flat_memory_gauges_aggregate_exactly():
+    """Same exactness contract over the flat FRAME_METRICS star — the
+    baseline the tree merge must match."""
+    _, out = _run_cp_fleet({
+        "HVD_TPU_CHAOS_CP_STEPS": "12",
+        "HVD_TPU_TREE_PORT_BASE": str(_free_port()),
+        "HVD_TPU_TREE": "off"})
+    assert "CHAOS_MEMGAUGES ranks=3 ok" in out
+
+
 def test_np3_tree_cache_replicas_survive_interior_merge():
     """Cache-replica alignment: with the response cache ON (the fleet
     default) the steady state broadcasts compact FRAME_RESPONSE_BATCH
