@@ -1710,6 +1710,10 @@ def _serving_bench(n_requests: int = 40, max_slots: int = 8,
     stat, stat_out = run(continuous=False)
     results_identical = cont_out == stat_out
 
+    prefix_section = _serving_prefix_bench(params, cfg,
+                                           max_slots=max_slots)
+    spec_section = _serving_spec_bench(max_slots=max_slots)
+
     # Bitwise contract: engine prefill+decode (cached executables) vs
     # the jitted non-incremental forward, as a greedy rollout.
     eng = InferenceEngine(params, cfg, max_slots=max_slots,
@@ -1742,6 +1746,205 @@ def _serving_bench(n_requests: int = 40, max_slots: int = 8,
         "bitwise_identical": bitwise,
         "requests": n_requests,
         "slots": max_slots,
+        "prefix_cache": prefix_section,
+        "speculative": spec_section,
+    }
+
+
+def _serving_prefix_bench(params, cfg, n_requests: int = 24,
+                          max_slots: int = 8, seed: int = 13) -> dict:
+    """Shared-prefix page-cache leg of ``--mode serving``: a
+    repeated-prefix trace (one 32-token system header + per-request
+    suffixes — the RAG/few-shot shape the cache monetizes) replayed
+    through the IDENTICAL engine with the prefix cache on vs off.
+    Gates (CI, --check-spec-speedup): completions BITWISE-equal
+    between the legs (cache hits are observably side-effect-free) and
+    ``prefill_tokens_saved > 0`` (the header's pages map copy-free
+    after the first admission); p50 TTFT per leg rides along — the
+    saved prefill work is the TTFT win."""
+    import numpy as np
+
+    from horovod_tpu import telemetry as _telemetry
+    from horovod_tpu.serving import InferenceEngine
+
+    rng = np.random.default_rng(seed)
+    header = [int(t) for t in rng.integers(0, cfg.vocab_size, size=32)]
+    trace = []
+    arrival = 0
+    for _ in range(n_requests):
+        arrival += int(rng.integers(0, 2))
+        trace.append({
+            "prompt": header + [int(t) for t in rng.integers(
+                0, cfg.vocab_size, size=int(rng.integers(4, 13)))],
+            "max_new": int(rng.integers(4, 13)),
+            "arrival": arrival,
+        })
+
+    def counter(name):
+        return _telemetry.metrics().get(name, {}).get("value", 0)
+
+    def run(prefix: bool):
+        eng = InferenceEngine(params, cfg, max_slots=max_slots,
+                              page_size=16, capacity=128,
+                              prefix_cache=prefix)
+        eng.warm_start()
+        for t in trace:  # steady state: pre-build the buckets
+            eng._prefill_exec(eng._bucket_for(len(t["prompt"])))
+            # ...including the suffix-only buckets hits compile to
+            # (the 32-token header is page-aligned at page_size=16).
+            eng._prefill_exec(eng._bucket_for(len(t["prompt"]) - 32))
+        pages_before = counter("serving.prefix_pages_shared")
+        reqs = [eng.submit(t["prompt"], max_new_tokens=t["max_new"],
+                           arrival=t["arrival"]) for t in trace]
+        it = 0
+        t0 = time.perf_counter()
+        while not eng.scheduler.idle():
+            eng.step(now=it)
+            it += 1
+        dt = time.perf_counter() - t0
+        pages = counter("serving.prefix_pages_shared") - pages_before
+        ttft = sorted(r.t_first_token - r.t_submit for r in reqs)
+        return {
+            "tokens_per_sec": round(
+                sum(len(r.generated) for r in reqs) / dt, 1),
+            "wall_seconds": round(dt, 3),
+            "ttft_p50_ms": round(ttft[len(ttft) // 2] * 1e3, 3),
+            "prefill_tokens_saved": int(pages) * eng.cache.page_size,
+            "prefix_stats": eng.cache.prefix_stats(),
+        }, [list(r.generated) for r in reqs]
+
+    on, on_out = run(prefix=True)
+    off, off_out = run(prefix=False)
+    return {
+        "on": on,
+        "off": off,
+        "bitwise_identical": on_out == off_out,
+        "prefill_tokens_saved": on["prefill_tokens_saved"],
+        "ttft_p50_improved": on["ttft_p50_ms"] <= off["ttft_p50_ms"],
+        "requests": n_requests,
+        "header_tokens": 32,
+    }
+
+
+def _serving_spec_bench(n_requests: int = 24, max_slots: int = 8,
+                        seed: int = 11, spec_tokens: int = 5) -> dict:
+    """Speculative-decoding leg of ``--mode serving``: the same seeded
+    heavy-tailed trace through the IDENTICAL target model with and
+    without a draft.  The pair is constructed for EXACT greedy
+    agreement (every layer's residual contribution is zeroed in both
+    models and the embed/unembed halves are shared, so target and
+    draft logits are bitwise-identical): acceptance is deterministically
+    1.0 and the measured speedup is the *mechanism's* — what the
+    dispatch structure buys at full acceptance, the honest upper bound
+    a CPU microbench can state (a real distilled draft lands wherever
+    its acceptance rate does; serving.spec_acceptance_rate reports it
+    live).  Gates (CI): speculative >= 1.3x non-speculative tokens/sec,
+    completions BITWISE-equal (the bitwise-greedy acceptance rule —
+    holds at ANY acceptance rate), and the steady-state dispatch
+    contract: one draft propose + ONE target verify executable call
+    per decode iteration, zero eager dispatches."""
+    import numpy as np
+
+    from horovod_tpu.models.transformer import TransformerConfig
+    from horovod_tpu.serving import InferenceEngine
+    from horovod_tpu.serving.harness import (agreement_pair,
+                                             count_spec_dispatches)
+
+    # FFN-heavy target, thin draft (~8% of the target's per-token
+    # compute): the economics speculative decoding monetizes — the
+    # verify's per-token cost is ~C_decode/2 regardless of depth (width
+    # scales with the block, amortization scales with it too), so the
+    # draft's relative cost decides the ceiling.
+    cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=8,
+                            n_layers=8, d_ff=1024, max_seq_len=128)
+    dcfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=8,
+                             n_layers=1, d_ff=64, max_seq_len=128)
+    params, draft = agreement_pair(cfg, dcfg)
+
+    rng = np.random.default_rng(seed)
+    trace = []
+    arrival = 0
+    for _ in range(n_requests):
+        arrival += int(rng.integers(0, 2))
+        if rng.random() < 0.25:
+            max_new = int(rng.integers(48, 65))
+        else:
+            max_new = int(rng.integers(4, 13))
+        trace.append({
+            "prompt": [int(t) for t in rng.integers(
+                0, cfg.vocab_size, size=int(rng.integers(4, 17)))],
+            "max_new": max_new,
+            "arrival": arrival,
+        })
+
+    def run(speculative: bool):
+        kw = {}
+        if speculative:
+            kw = {"draft": (draft, dcfg), "spec_tokens": spec_tokens}
+        eng = InferenceEngine(params, cfg, max_slots=max_slots,
+                              page_size=16, capacity=128, **kw)
+        eng.warm_start()
+        for t in trace:
+            eng._prefill_exec(eng._bucket_for(len(t["prompt"])))
+            if speculative:
+                eng._prefill_exec(eng._bucket_for(len(t["prompt"])),
+                                  draft=True)
+        reqs = [eng.submit(t["prompt"], max_new_tokens=t["max_new"],
+                           arrival=t["arrival"]) for t in trace]
+        it = 0
+        t0 = time.perf_counter()
+        while not eng.scheduler.idle():
+            eng.step(now=it)
+            it += 1
+        dt = time.perf_counter() - t0
+        tokens = sum(len(r.generated) for r in reqs)
+        return {
+            "tokens_per_sec": round(tokens / dt, 1),
+            "tokens": tokens,
+            "iterations": it,
+            "wall_seconds": round(dt, 3),
+            "acceptance_rate": (round(eng.spec_acceptance_rate, 4)
+                                if eng.spec_acceptance_rate is not None
+                                else None),
+        }, [list(r.generated) for r in reqs], eng
+
+    # Best-of-2 per leg: the verdicts are deterministic (identical
+    # completions every repeat — asserted), only the wall clock on a
+    # shared box is not, and a transient load spike on either leg must
+    # not flip the CI gate.
+    spec, spec_out, spec_eng = run(speculative=True)
+    spec2, spec_out2, eng2 = run(speculative=True)
+    if spec2["tokens_per_sec"] > spec["tokens_per_sec"]:
+        spec, spec_eng = spec2, eng2
+    base, base_out, _ = run(speculative=False)
+    base2, base_out2, _ = run(speculative=False)
+    if base2["tokens_per_sec"] > base["tokens_per_sec"]:
+        base = base2
+    repeats_identical = (spec_out == spec_out2
+                         and base_out == base_out2)
+
+    # Steady-state dispatch contract on the spec engine: one propose +
+    # ONE verify executable call per decode iteration, nothing eager —
+    # the same harness tests/test_speculative.py asserts through.
+    for p in ([1, 2, 3], [4, 5, 6, 7]):
+        spec_eng.submit(list(p), max_new_tokens=spec_tokens + 3)
+    spec_eng.step()  # admissions + prefills
+    proposes, verifies, eager = count_spec_dispatches(spec_eng)
+    calls = {"verify": verifies, "propose": proposes}
+    spec_eng.run_until_idle()
+
+    speedup = (round(spec["tokens_per_sec"] / base["tokens_per_sec"], 2)
+               if base["tokens_per_sec"] else None)
+    return {
+        "speculative": spec,
+        "non_speculative": base,
+        "speedup": speedup,
+        "bitwise_greedy": spec_out == base_out and repeats_identical,
+        "spec_tokens": spec_tokens,
+        "verify_dispatches_per_iteration": calls["verify"],
+        "propose_dispatches_per_iteration": calls["propose"],
+        "eager_dispatches_per_iteration": eager,
+        "requests": n_requests,
     }
 
 
@@ -1822,7 +2025,9 @@ def main() -> int:
                          "loader, prefetch+async on vs off (no TPU "
                          "tunnel); serving = hvd-serve tokens/sec, "
                          "continuous vs static batching on a seeded "
-                         "ragged-arrival trace (no TPU tunnel); overlap "
+                         "ragged-arrival trace, plus the hvd-spec "
+                         "prefix-cache and speculative-decoding legs "
+                         "(no TPU tunnel); overlap "
                          "= backward/communication overlap steps/sec, "
                          "streamed vs serialized bucket dispatch on a "
                          "transformer-LM chain, plus the bitwise "
@@ -1858,6 +2063,19 @@ def main() -> int:
                          "the 1f1b exposed-bubble seconds are not "
                          "strictly below the gpipe leg's OR the "
                          "bitwise/reference parity gates fail")
+    ap.add_argument("--check-spec-speedup", type=float, default=None,
+                    help="serving mode: exit nonzero when speculative/"
+                         "non-speculative tokens/sec on the seeded "
+                         "heavy-tailed trace is below this bound, when "
+                         "speculative completions are not bitwise-equal "
+                         "to non-speculative greedy (the bitwise-greedy "
+                         "acceptance rule), when a steady-state "
+                         "speculative iteration is not exactly one "
+                         "draft propose + ONE target verify executable "
+                         "dispatch with zero eager launches, when the "
+                         "prefix-cache leg's completions differ from "
+                         "cache-off, or when the repeated-prefix trace "
+                         "saves no prefill tokens")
     ap.add_argument("--check-wire-ratio", type=float, default=None,
                     help="dataplane mode: exit nonzero when the int8 "
                          "bytes-on-wire compression ratio is below this "
@@ -2190,6 +2408,41 @@ def main() -> int:
                 failures.append(
                     "engine prefill+decode rollout diverges from the "
                     "non-incremental serving_forward")
+            if failures:
+                for f in failures:
+                    print(f"FAIL: {f}", file=sys.stderr)
+                return 1
+        if args.check_spec_speedup is not None:
+            failures = []
+            spec = result.get("speculative", {})
+            prefix = result.get("prefix_cache", {})
+            if (spec.get("speedup") or 0.0) < args.check_spec_speedup:
+                failures.append(
+                    f"speculative speedup {spec.get('speedup')}x < "
+                    f"required {args.check_spec_speedup}x")
+            if not spec.get("bitwise_greedy"):
+                failures.append(
+                    "speculative completions diverge from "
+                    "non-speculative greedy (bitwise-greedy acceptance "
+                    "broken)")
+            if (spec.get("verify_dispatches_per_iteration") != 1
+                    or spec.get("propose_dispatches_per_iteration") != 1
+                    or spec.get("eager_dispatches_per_iteration") != 0):
+                failures.append(
+                    f"speculative steady state is not 1 propose + 1 "
+                    f"verify dispatch with zero eager launches "
+                    f"(got propose="
+                    f"{spec.get('propose_dispatches_per_iteration')}, "
+                    f"verify="
+                    f"{spec.get('verify_dispatches_per_iteration')}, "
+                    f"eager="
+                    f"{spec.get('eager_dispatches_per_iteration')})")
+            if not prefix.get("bitwise_identical"):
+                failures.append(
+                    "prefix-cache completions diverge from cache-off")
+            if (prefix.get("prefill_tokens_saved") or 0) <= 0:
+                failures.append(
+                    "repeated-prefix trace saved no prefill tokens")
             if failures:
                 for f in failures:
                     print(f"FAIL: {f}", file=sys.stderr)

@@ -53,6 +53,16 @@ def main():
                          "attention/FFN over a 'model' mesh axis)")
     ap.add_argument("--slots", type=int, default=8,
                     help="decode batch slots (continuous batching)")
+    ap.add_argument("--draft", type=str, default=None,
+                    help="serving checkpoint of a DRAFT model (same "
+                         "vocab): arms speculative decoding with the "
+                         "bitwise-greedy acceptance rule "
+                         "(docs/inference.md)")
+    ap.add_argument("--spec-tokens", type=int, default=None,
+                    help="draft proposals per iteration (with --draft; "
+                         "default HVD_TPU_SPEC_TOKENS)")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable the shared-prefix page cache")
     args = ap.parse_args()
 
     params, cfg, meta = load_serving_checkpoint(args.checkpoint)
@@ -60,8 +70,15 @@ def main():
     if args.tp > 1:
         mesh = make_mesh(data=1, model=args.tp,
                          devices=jax.devices()[:args.tp])
+    draft = None
+    if args.draft is not None:
+        dparams, dcfg, _ = load_serving_checkpoint(args.draft)
+        draft = (dparams, dcfg)
     engine = InferenceEngine(params, cfg, mesh=mesh,
-                             max_slots=args.slots)
+                             max_slots=args.slots, draft=draft,
+                             spec_tokens=args.spec_tokens,
+                             prefix_cache=(False if args.no_prefix_cache
+                                           else None))
 
     if args.serve:
         server = LMServer(engine, port=args.port).start()

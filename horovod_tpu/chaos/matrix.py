@@ -187,6 +187,15 @@ SCENARIOS: List[Scenario] = [
         doc="a burst of concurrent /generate requests: every one "
             "completes or fails explicitly — the front door never "
             "hangs"),
+    Scenario(
+        "serving_spec_disconnect", "local", "recover", cap=300.0,
+        spec="serving.disconnect:count=1@27",
+        needle="disconnected mid-generation",
+        doc="client vanishes mid-SPECULATION (draft model + prefix "
+            "cache live): the iteration-boundary abort releases the "
+            "target AND draft KV slots and decrements the prefix "
+            "refcounts; the follow-up request (a prefix-cache hit) "
+            "completes identically to the fault-free run"),
 ]
 
 
@@ -617,6 +626,73 @@ def scenario_serving_disconnect() -> None:
         srv.close()
 
 
+def scenario_serving_spec_disconnect() -> None:
+    """serving.disconnect fires mid-SPECULATION: the engine runs a
+    draft model (speculative decoding) and the prefix cache, the first
+    request dies at the client probe, and its iteration-boundary abort
+    must release the target AND draft KV slots and decrement the
+    prefix refcounts (a leak would show as diverging page accounting).
+    The follow-up request shares the first one's prompt header — a
+    prefix-cache hit — and must complete identically to the fault-free
+    pass."""
+    import jax
+
+    from .. import chaos as _chaos
+    from .. import telemetry as _telemetry
+    from ..models.transformer import TransformerConfig, init_transformer
+    from ..serving.engine import InferenceEngine
+    from ..serving.server import LMServer
+
+    cfg = TransformerConfig(vocab_size=256, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64)
+    dcfg = TransformerConfig(vocab_size=256, d_model=32, n_heads=2,
+                             n_layers=1, d_ff=32, max_seq_len=64)
+    params = init_transformer(jax.random.PRNGKey(5), cfg)
+    draft = init_transformer(jax.random.PRNGKey(6), dcfg)
+    engine = InferenceEngine(params, cfg, max_slots=2, page_size=8,
+                             capacity=64, draft=(draft, dcfg),
+                             spec_tokens=3, prefix_cache=True)
+    srv = LMServer(engine, port=0).start()
+    try:
+        faulted = _chaos.active()
+        header = list(range(40, 56))  # two full 8-token pages
+        first: dict = {}
+        try:
+            first = _post_generate(
+                srv.port, {"tokens": header + [5, 6, 7],
+                           "max_tokens": 24, "timeout": 45.0})
+        except Exception as e:  # noqa: BLE001 — 499 surfaces as an
+            # HTTPError on the faulted pass; the follow-up is the test
+            first = {"error": str(e)}
+        follow = _post_generate(
+            srv.port, {"tokens": header + [9, 10, 11],
+                       "max_tokens": 8, "timeout": 45.0})
+        if faulted:
+            snap = _telemetry.metrics()
+            got = snap.get("serving.client_disconnects",
+                           {}).get("value", 0)
+            if got < 1:
+                _diag(0, f"client disconnect was injected but never "
+                         f"counted (serving.client_disconnects={got}; "
+                         f"first reply: {first})")
+        # Page accounting after the abort: every slot idle, so free +
+        # cached must cover every allocatable page on BOTH stores, and
+        # no cached page may still hold a reference — a leak here is a
+        # divergence between the passes (the digest covers it).
+        stats = engine.cache.prefix_stats()
+        target_ok = (engine.cache.free_pages()
+                     == engine.cache.total_pages)
+        draft_ok = (engine.draft_cache.free_pages()
+                    == engine.draft_cache.total_pages)
+        _result(0, [("serve", tuple(follow["tokens"]),
+                     follow["finish_reason"]),
+                    ("pages", target_ok, draft_ok,
+                     stats["referenced_pages"],
+                     stats["cached_pages"])])
+    finally:
+        srv.close()
+
+
 def scenario_serving_storm() -> None:
     """A burst of concurrent /generate requests against two decode
     slots: every request must complete (or fail explicitly) — the
@@ -656,6 +732,7 @@ LOCAL_SCENARIOS = {
     "ckpt_exhaustion": lambda: scenario_ckpt(exhaust=True),
     "input_stall": scenario_input_stall,
     "serving_disconnect": scenario_serving_disconnect,
+    "serving_spec_disconnect": scenario_serving_spec_disconnect,
     "serving_storm": scenario_serving_storm,
 }
 

@@ -354,7 +354,11 @@ class ServingState(State):
     The request list rides the commit as a JSON blob in a uint8 array:
     its LENGTH changes between commits, which the fixed-structure
     pytree round trip of :class:`State` tolerates only for raw array
-    leaves.
+    leaves.  The blob also carries the engine's shared-prefix index
+    (hash → token ids, exported as the maximal cached chains), so a
+    relaunched fleet REBUILDS the shared pages on ``sync()`` — one
+    ghost prefill per chain — instead of re-prefilling every cached
+    prefix cold on its first live hit (hvd-spec satellite).
 
     Usage (mirrors :class:`TrainerState`)::
 
@@ -377,8 +381,14 @@ class ServingState(State):
 
         if exported is None:
             exported = self._engine.export_requests()
-        return np.frombuffer(json.dumps(exported).encode(),
+        payload = {"requests": exported,
+                   "prefixes": self._export_prefixes()}
+        return np.frombuffer(json.dumps(payload).encode(),
                              np.uint8).copy()
+
+    def _export_prefixes(self) -> List[List[int]]:
+        export = getattr(self._engine, "export_prefix_index", None)
+        return export() if export is not None else []
 
     def _capture(self) -> None:
         self._values["requests_blob"] = self._blob()
@@ -387,11 +397,18 @@ class ServingState(State):
         import json
 
         blob = bytes(np.asarray(self._values["requests_blob"]))
-        exported = json.loads(blob.decode() or "[]")
+        payload = json.loads(blob.decode() or "[]")
+        if isinstance(payload, list):  # pre-prefix-cache blob format
+            payload = {"requests": payload, "prefixes": []}
         # Clear whatever the engine currently holds (retry path: the
-        # committed set replaces it wholesale), then resubmit.
+        # committed set replaces it wholesale), seed the shared-prefix
+        # pages (ghost prefills — cheap, and the resubmitted
+        # continuations below already hit them), then resubmit.
         self._engine.drain()
-        self._engine.import_requests(exported)
+        seed = getattr(self._engine, "seed_prefixes", None)
+        if seed is not None and payload.get("prefixes"):
+            seed(payload["prefixes"])
+        self._engine.import_requests(payload.get("requests", []))
 
     def commit(self) -> None:
         self._capture()
@@ -400,7 +417,9 @@ class ServingState(State):
     def drain_commit(self) -> List[dict]:
         """Resize step 1: drain the engine (stop admission, evict
         in-flight sequences as continuations) and commit the captured
-        request set.  Returns the export for inspection/logging."""
+        request set plus the shared-prefix index (exported AFTER the
+        drain, so pages the evictions just unreferenced are still in
+        it).  Returns the export for inspection/logging."""
         exported = self._engine.drain()
         self._values["requests_blob"] = self._blob(exported)
         super().commit()

@@ -42,7 +42,10 @@ def _build(args: argparse.Namespace) -> "planner.MemoryPlan":
             n_layers=args.layers, n_heads=args.heads,
             head_dim=args.head_dim, max_slots=args.kv_slots,
             pages_per_slot=args.kv_pages, page_size=args.page_size,
-            world=args.world, dtype=args.dtype, capacity=cap)
+            world=args.world, dtype=args.dtype,
+            prefix_pages=args.prefix_pages,
+            draft_layers=args.draft_layers,
+            vocab_size=args.vocab_size, capacity=cap)
     return planner.plan_transformer_lm(
         vocab_size=args.vocab_size, d_model=args.d_model,
         n_heads=args.heads, n_layers=args.layers, d_ff=args.d_ff,
@@ -85,6 +88,14 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-pages", type=int, default=8,
                     help="pages per slot")
     ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--prefix-pages", type=int, default=0,
+                    help="dedicated shared-prefix page reserve "
+                         "(hvd-spec; the serving.prefix_pages ledger "
+                         "partition)")
+    ap.add_argument("--draft-layers", type=int, default=0,
+                    help="speculative-decoding draft model depth "
+                         "(prices serving.draft_kv + "
+                         "serving.draft_params; 0 = no draft)")
     # pipeline what-ifs
     ap.add_argument("--stages", type=int, default=4)
     ap.add_argument("--microbatches", type=int, default=8)

@@ -66,6 +66,9 @@ CATEGORIES = (
     "megakernel.fusion",
     "megakernel.residuals",
     "serving.kv_pages",
+    "serving.prefix_pages",
+    "serving.draft_kv",
+    "serving.draft_params",
     "input.prefetch",
     "pipeline.activations",
     "checkpoint.snapshots",

@@ -95,10 +95,23 @@ def kv_cache_bytes(n_layers: int, n_heads: int, head_dim: int,
                    max_slots: int, pages_per_slot: int, page_size: int,
                    dtype="float32") -> int:
     """K + V page arrays of serving/kv_cache.PagedKVCache (the +1 is
-    the reserved trash page)."""
+    the reserved trash page; a dedicated prefix reserve is priced
+    separately by :func:`prefix_pages_bytes` — the same partition the
+    runtime ledger charges)."""
     n_pages = 1 + max_slots * pages_per_slot
     return (2 * n_layers * n_pages * page_size * n_heads * head_dim
             * dtype_bytes(dtype))
+
+
+def prefix_pages_bytes(n_layers: int, n_heads: int, head_dim: int,
+                       n_prefix_pages: int, page_size: int,
+                       dtype="float32") -> int:
+    """K + V bytes of a dedicated shared-prefix page reserve
+    (``PagedKVCache(prefix_pages=N)``) — the ``--prefix-pages``
+    what-if, and byte-for-byte the ``serving.prefix_pages`` ledger
+    partition the runtime charges at cache construction."""
+    return (2 * n_layers * n_prefix_pages * page_size * n_heads
+            * head_dim * dtype_bytes(dtype))
 
 
 def pipeline_activation_bytes(n_stages: int, num_microbatches: int,
@@ -291,14 +304,14 @@ def _transformer_param_bytes(vocab_size: int, d_model: int,
     untied head) — pure arithmetic, no tracing, so the CLI stays
     hardware-free and deterministic."""
     item = dtype_bytes(dtype)
-    per_layer = (4 * d_model * d_model + 4 * d_model        # attn + bias
-                 + 2 * d_model * d_ff + d_ff + d_model      # ffn
+    per_layer = (4 * d_model * d_model                      # wq/wk/wv/wo
+                 + 2 * d_model * d_ff + d_ff + d_model      # ffn + biases
                  + 4 * d_model)                             # 2 x ln
     total = (vocab_size * d_model                           # embedding
              + max_seq_len * d_model                        # positions
              + n_layers * per_layer
              + 2 * d_model                                  # final ln
-             + d_model * vocab_size + vocab_size)           # lm head
+             + d_model * vocab_size)                        # untied head
     return total * item
 
 
@@ -386,24 +399,56 @@ def plan_serving(n_layers: int, n_heads: int, head_dim: int,
                  max_slots: int, pages_per_slot: int, page_size: int,
                  world: int = 1, dtype: str = "float32",
                  param_bytes: int = 0,
+                 prefix_pages: int = 0,
+                 draft_layers: int = 0,
+                 draft_d_ff: Optional[int] = None,
+                 vocab_size: int = 256,
                  capacity: Optional[int] = None) -> MemoryPlan:
     """Plan for the serving engine: the paged KV store (the dominant
     framework buffer) plus replicated params.  The KV what-ifs —
     slots, pages per slot, page size — are the router tier's capacity
-    question (ROADMAP item 2)."""
+    question (ROADMAP item 2).  hvd-spec what-ifs: ``--prefix-pages``
+    prices a dedicated shared-prefix reserve
+    (:func:`prefix_pages_bytes`, the runtime's ledger partition) and
+    ``--draft-layers`` a speculative-decoding draft model over the
+    same slots — its own KV store (:func:`kv_cache_bytes`, the same
+    formula the draft ``PagedKVCache`` charges ``serving.draft_kv``
+    with) plus its replicated parameters
+    (:func:`_transformer_param_bytes`, exact for ``init_transformer``
+    trees; draft ``d_model = n_heads * head_dim``, ``d_ff`` defaults
+    to ``4 * d_model``, positions sized to the KV capacity)."""
     kv = kv_cache_bytes(n_layers, n_heads, head_dim, max_slots,
                         pages_per_slot, page_size, dtype)
+    framework = {"serving.kv_pages": kv}
+    facts = {"kv_capacity_tokens": max_slots * pages_per_slot
+             * page_size}
+    if prefix_pages:
+        framework["serving.prefix_pages"] = prefix_pages_bytes(
+            n_layers, n_heads, head_dim, prefix_pages, page_size,
+            dtype)
+        facts["prefix_pages"] = prefix_pages
+    if draft_layers:
+        d_model = n_heads * head_dim
+        framework["serving.draft_kv"] = kv_cache_bytes(
+            draft_layers, n_heads, head_dim, max_slots,
+            pages_per_slot, page_size, dtype)
+        framework["serving.draft_params"] = _transformer_param_bytes(
+            vocab_size, d_model, n_heads, draft_layers,
+            draft_d_ff if draft_d_ff is not None else 4 * d_model,
+            pages_per_slot * page_size, dtype)
+        facts["draft_layers"] = draft_layers
     return MemoryPlan(
         model="serving",
         config={"n_layers": n_layers, "n_heads": n_heads,
                 "head_dim": head_dim, "max_slots": max_slots,
                 "pages_per_slot": pages_per_slot,
-                "page_size": page_size, "dtype": dtype},
+                "page_size": page_size, "dtype": dtype,
+                "prefix_pages": prefix_pages,
+                "draft_layers": draft_layers},
         world=world,
         sections={"params": param_bytes},
-        facts={"kv_capacity_tokens": max_slots * pages_per_slot
-               * page_size},
-        framework={"serving.kv_pages": kv},
+        facts=facts,
+        framework=framework,
         capacity_bytes=capacity)
 
 

@@ -572,6 +572,75 @@ def forward_step(params, tokens, start_pos, k_view, v_view,
     return logits, jnp.stack(k_news), jnp.stack(v_news)
 
 
+def _put_view(view, new, pos):
+    """Scatter one KV entry per sequence into a fixed-capacity view:
+    ``view [n_layers, b, capacity, heads, head_dim]``, ``new
+    [n_layers, b, heads, head_dim]`` written at per-sequence position
+    ``pos [b]`` (rows past the capacity drop — the same mode="drop"
+    discipline as :func:`forward_step`'s in-block put)."""
+    def one(vb, nb, pb):
+        return vb.at[pb].set(nb, mode="drop")
+    return jax.vmap(jax.vmap(one, in_axes=(0, 0, 0)),
+                    in_axes=(0, 0, None))(view, new, pos)
+
+
+def speculative_propose(params, prev, pending, start_pos, k_view,
+                        v_view, cfg: TransformerConfig, n_propose: int):
+    """Greedy draft rollout for speculative decoding (hvd-spec): ONE
+    program proposing ``n_propose`` tokens per sequence by unrolling
+    that many cache-aware forward steps over the draft's KV view.
+
+    ``prev``/``pending``: ``[b]`` int32 — the second-newest context
+    token (at global position ``start_pos``) and the newest, not yet
+    cached one (at ``start_pos + 1``).  The first step is a width-2
+    block of BOTH real tokens: re-deriving ``prev``'s KV is either an
+    exact overwrite (the values are a pure function of the token, its
+    position and the accepted prefix — bitwise-identical on
+    recomputation) or, after a fully accepted previous iteration, the
+    catch-up write for the one draft token whose KV the draft never
+    computed (it was the last PROPOSAL, not an input).  That single
+    rule keeps the program shape identical for every slot in a mixed
+    batch — no per-slot catch-up flag.
+
+    Subsequent steps run ``[token, dummy]`` width-2 blocks (the same
+    M>=2 gemm discipline as decode) feeding each argmax proposal back
+    in, with the freshly derived KV scattered into the view between
+    steps so step ``j+1`` attends to step ``j``'s entry.
+
+    Returns ``(proposals [b, n_propose] int32, k_writes, v_writes)``
+    where the writes are ``[n_layers, b, n_propose + 1, heads,
+    head_dim]`` — the KV entries for global positions ``start_pos ..
+    start_pos + n_propose``, for the caller to scatter into its paged
+    store.
+    """
+    if n_propose < 1:
+        raise ValueError(f"n_propose must be >= 1, got {n_propose}")
+    kv, vv = k_view, v_view
+    k_cols, v_cols = [], []
+    blk = jnp.stack([prev, pending], axis=1)
+    logits, kn, vn = forward_step(params, blk, start_pos, kv, vv, cfg)
+    cur = jnp.argmax(logits[:, 1], axis=-1).astype(jnp.int32)
+    proposals = [cur]
+    k_cols += [kn[:, :, 0], kn[:, :, 1]]
+    v_cols += [vn[:, :, 0], vn[:, :, 1]]
+    # prev's entry must land in the view too: after a fully-accepted
+    # iteration it is the catch-up fill, and steps >= 2 attend to it.
+    kv = _put_view(kv, k_cols[0], start_pos)
+    vv = _put_view(vv, v_cols[0], start_pos)
+    for j in range(1, n_propose):
+        kv = _put_view(kv, k_cols[-1], start_pos + j)
+        vv = _put_view(vv, v_cols[-1], start_pos + j)
+        blk = jnp.stack([cur, jnp.zeros_like(cur)], axis=1)
+        logits, kn, vn = forward_step(params, blk, start_pos + 1 + j,
+                                      kv, vv, cfg)
+        cur = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        proposals.append(cur)
+        k_cols.append(kn[:, :, 0])
+        v_cols.append(vn[:, :, 0])
+    return (jnp.stack(proposals, axis=1),
+            jnp.stack(k_cols, axis=2), jnp.stack(v_cols, axis=2))
+
+
 def serving_forward(params, tokens, cfg: TransformerConfig,
                     capacity: Optional[int] = None):
     """Non-incremental reference for the serving path: the full sequence

@@ -38,8 +38,10 @@ executes np>1 collectives (CI), not the container's 0.4.37.
 
 from __future__ import annotations
 
+import json
+import os
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +76,32 @@ _M_DECODES = _telemetry.counter(
     "serving.decode_iterations", "batched decode iterations")
 _M_WARM = _telemetry.counter(
     "serving.warm_starts", "serving executables AOT-rebuilt at startup")
+_M_SPEC_PROPOSED = _telemetry.counter(
+    "serving.spec_proposed", "draft tokens proposed per speculative "
+    "iteration (spec_tokens per active greedy slot)")
+_M_SPEC_ACCEPTED = _telemetry.counter(
+    "serving.spec_accepted", "draft tokens the bitwise-greedy verify "
+    "accepted (the bonus/correction token is not counted)")
+_M_SPEC_RATE = _telemetry.gauge(
+    "serving.spec_acceptance_rate", "cumulative spec_accepted / "
+    "spec_proposed for this engine")
+
+
+def _model_dict(cfg) -> dict:
+    """One model-identity dict for every identity consumer — the
+    prefix-cache fingerprint, the manifest's model field, and the
+    draft identity on speculative entries.  A new config field that
+    changes compiled programs or KV content belongs HERE, once."""
+    return {
+        "vocab_size": cfg.vocab_size,
+        "d_model": cfg.d_model,
+        "n_heads": cfg.n_heads,
+        "n_layers": cfg.n_layers,
+        "d_ff": cfg.d_ff,
+        "max_seq_len": cfg.max_seq_len,
+        "num_experts": cfg.num_experts,
+        "dtype": jnp.dtype(cfg.dtype).name,
+    }
 
 
 class InferenceEngine:
@@ -100,7 +128,11 @@ class InferenceEngine:
     def __init__(self, params: Any, cfg, *, mesh=None, max_slots: int = 8,
                  page_size: int = 16, capacity: Optional[int] = None,
                  eos_id: Optional[int] = None,
-                 model_axis: str = MODEL_AXIS) -> None:
+                 model_axis: str = MODEL_AXIS,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_pages: int = 0,
+                 draft: Optional[Tuple[Any, Any]] = None,
+                 spec_tokens: Optional[int] = None) -> None:
         cap = capacity if capacity is not None else cfg.max_seq_len
         cap = min(cap, cfg.max_seq_len)
         cap -= cap % page_size
@@ -120,10 +152,21 @@ class InferenceEngine:
         self.mesh = mesh
         self.eos_id = eos_id
         self.max_slots = max_slots
+        # Shared-prefix page cache (hvd-spec): on unless the env or the
+        # kwarg opts out; hits are bitwise-invisible, so the default is
+        # safe.  The fingerprint keys the chain hashes to this model's
+        # config — the cache is per-engine, so parameters are fixed
+        # once the fingerprint matches.
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "HVD_TPU_PREFIX_CACHE", "1") != "0"
+        fingerprint = json.dumps(_model_dict(cfg), sort_keys=True)
         self.cache = PagedKVCache(
             cfg.n_layers, cfg.n_heads, cfg.d_model // cfg.n_heads,
             max_slots, cap // page_size, page_size,
-            dtype=cfg.dtype, mesh=mesh, model_axis=model_axis)
+            dtype=cfg.dtype, mesh=mesh, model_axis=model_axis,
+            prefix_cache=prefix_cache, prefix_pages=prefix_pages,
+            fingerprint=fingerprint)
         self.capacity = self.cache.capacity
         self.scheduler = ContinuousBatchingScheduler(max_slots,
                                                      self.capacity)
@@ -134,6 +177,67 @@ class InferenceEngine:
         else:
             params = jax.tree_util.tree_map(jnp.asarray, params)
         self.params = params
+        # Speculative decoding (hvd-spec): a draft model over the same
+        # mesh proposes spec_tokens greedy tokens per iteration; ONE
+        # donated verify executable runs the target over the block and
+        # accepts via the bitwise-greedy rule.  Draft absent => the
+        # decode path is bitwise-unchanged.
+        if spec_tokens is None:
+            spec_tokens = int(os.environ.get("HVD_TPU_SPEC_TOKENS", "3"))
+        self.spec_tokens = spec_tokens
+        self._draft_params = None
+        self._draft_cfg = None
+        self.draft_cache: Optional[PagedKVCache] = None
+        if draft is not None:
+            # Validated only when a draft is armed: without one the
+            # depth is unused, and HVD_TPU_SPEC_TOKENS=0 in the
+            # environment must not break draft-less engines.
+            if spec_tokens < 1:
+                raise ValueError(
+                    f"spec_tokens must be >= 1, got {spec_tokens}")
+            draft_params, draft_cfg = draft
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size {draft_cfg.vocab_size} must "
+                    f"match the target's {cfg.vocab_size} (the "
+                    f"acceptance rule compares token ids)")
+            if draft_cfg.max_seq_len < cap:
+                raise ValueError(
+                    f"draft max_seq_len {draft_cfg.max_seq_len} must "
+                    f"cover the KV capacity {cap}")
+            self._draft_cfg = draft_cfg
+            self.draft_cache = PagedKVCache(
+                draft_cfg.n_layers, draft_cfg.n_heads,
+                draft_cfg.d_model // draft_cfg.n_heads,
+                max_slots, cap // page_size, page_size,
+                dtype=draft_cfg.dtype, mesh=mesh, model_axis=model_axis,
+                ledger_category="serving.draft_kv")
+            if mesh is not None and self.cache.page_sharding() is not None:
+                rep = NamedSharding(mesh, P())
+                draft_params = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(jnp.asarray(x), rep),
+                    draft_params)
+            else:
+                draft_params = jax.tree_util.tree_map(jnp.asarray,
+                                                      draft_params)
+            self._draft_params = draft_params
+            # hvd-mem: the draft's replicated parameters are a
+            # framework-resident cost the planner's --draft-layers
+            # what-if predicts; account the per-process resident bytes.
+            from ..memory import ledger as _mem_ledger
+
+            self._draft_ledger_key = id(self)
+            if _mem_ledger.enabled():
+                _mem_ledger.ledger.alloc(
+                    "serving.draft_params",
+                    sum(_mem_ledger.resident_nbytes(x) for x in
+                        jax.tree_util.tree_leaves(draft_params)),
+                    key=self._draft_ledger_key)
+            import weakref
+
+            weakref.finalize(self, _mem_ledger.ledger.free,
+                             "serving.draft_params",
+                             key=self._draft_ledger_key)
         self._buckets = [b for b in
                          (2 ** i for i in range(1, 31))
                          if b <= self.capacity]
@@ -141,6 +245,12 @@ class InferenceEngine:
             self._buckets.append(self.capacity)
         self._exec: Dict[Tuple, Any] = {}
         self._last_token = np.zeros((max_slots,), np.int32)
+        # The second-newest context token per slot — the catch-up
+        # column of the draft's propose block (see
+        # models/transformer.speculative_propose).
+        self._prev_token = np.zeros((max_slots,), np.int32)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._ready = False
         self._drained = False
         # Serializes drain/abort_all/import_requests: the serve loop's
@@ -175,12 +285,21 @@ class InferenceEngine:
         needs admission HEADROOM (can this replica take a long prompt)
         next to queue depth — occupancy alone says nothing about how
         full the occupied slots' page budgets are."""
+        prefix = self.cache.prefix_stats()
         return self._ready, {
             "ready": self._ready,
             "queue_depth": self.scheduler.queue_depth(),
             "batch_occupancy": self.scheduler.occupancy(),
+            # free_pages() already counts the prefix cache's
+            # reclaimable pages, so the router's headroom figure stays
+            # honest with a warm prefix index resident.
             "kv_free_pages": self.cache.free_pages(),
             "kv_total_pages": self.cache.total_pages,
+            "kv_reclaimable_pages": prefix["reclaimable_pages"],
+            "prefix_cached_pages": prefix["cached_pages"],
+            "speculative": self._draft_params is not None,
+            "spec_tokens": (self.spec_tokens
+                            if self._draft_params is not None else 0),
             "slots": self.max_slots,
             "executables": len(self._exec),
         }
@@ -201,27 +320,52 @@ class InferenceEngine:
             directory = self._manifest_dir
         self._manifest_dir = directory
         ident = self._manifest_identity()
+        draft_ident = self._draft_model_dict()
         warmed = 0
         for entry in _megakernel.serving_entries(directory):
             if any(entry.get(k) != ident[k]
                    for k in ("model", "mesh", "slots", "page_size",
                              "pages_per_slot")):
                 continue
+            kind = entry.get("kind")
+            # Speculative executables are keyed to the draft model and
+            # the speculation depth too: a relaunch with a different
+            # draft (or none) must not rebuild a foreign program.
+            if kind in ("verify", "draft_propose", "draft_prefill"):
+                if (draft_ident is None
+                        or entry.get("draft") != draft_ident
+                        or entry.get("spec") != self.spec_tokens):
+                    continue
             try:
-                if entry.get("kind") == "decode":
+                if kind == "decode":
                     self._decode_exec()
-                elif entry.get("kind") == "prefill":
+                elif kind == "prefill":
                     b = int(entry.get("bucket") or 0)
                     if b in self._buckets:
                         self._prefill_exec(b)
                     else:
                         continue
+                elif kind == "draft_prefill":
+                    b = int(entry.get("bucket") or 0)
+                    if b in self._buckets:
+                        self._prefill_exec(b, draft=True)
+                    else:
+                        continue
+                elif kind == "verify":
+                    self._verify_exec()
+                elif kind == "draft_propose":
+                    self._propose_exec()
                 else:
                     continue
                 warmed += 1
             except Exception:  # noqa: BLE001 — a stale entry must not
                 continue       # block startup; it just compiles lazily
         self._decode_exec()  # readiness == "can decode", manifest or not
+        if self._draft_params is not None:
+            # Readiness with a draft also means "can speculate": both
+            # per-iteration executables exist before the first request.
+            self._propose_exec()
+            self._verify_exec()
         if warmed:
             _M_WARM.inc(warmed)
         # hvd-mem pre-flight: the engine's PER-DEVICE working set (one
@@ -240,6 +384,14 @@ class InferenceEngine:
                               self.cache.v_pages)
                           + sum(_mem_ledger.device_nbytes(x) for x in
                                 jax.tree_util.tree_leaves(self.params)))
+            if self.draft_cache is not None:
+                per_device += (
+                    _mem_ledger.device_nbytes(self.draft_cache.k_pages)
+                    + _mem_ledger.device_nbytes(
+                        self.draft_cache.v_pages)
+                    + sum(_mem_ledger.device_nbytes(x) for x in
+                          jax.tree_util.tree_leaves(
+                              self._draft_params)))
             _oom.preflight_warn(per_device, "serving.warm_start",
                                 "KV shard + replicated params "
                                 "(per-device bytes)")
@@ -257,25 +409,25 @@ class InferenceEngine:
     def _manifest_identity(self) -> dict:
         return {
             "variant": "serving",
-            "model": {
-                "vocab_size": self.cfg.vocab_size,
-                "d_model": self.cfg.d_model,
-                "n_heads": self.cfg.n_heads,
-                "n_layers": self.cfg.n_layers,
-                "d_ff": self.cfg.d_ff,
-                "max_seq_len": self.cfg.max_seq_len,
-                "dtype": jnp.dtype(self.cfg.dtype).name,
-            },
+            "model": _model_dict(self.cfg),
             "slots": self.max_slots,
             "page_size": self.cache.page_size,
             "pages_per_slot": self.cache.pages_per_slot,
             "mesh": _megakernel.mesh_fingerprint(self._mesh_key()),
         }
 
+    def _draft_model_dict(self) -> Optional[dict]:
+        if self._draft_cfg is None:
+            return None
+        return _model_dict(self._draft_cfg)
+
     def _record(self, kind: str, bucket: Optional[int]) -> None:
         entry = dict(self._manifest_identity())
         entry["kind"] = kind
         entry["bucket"] = bucket
+        if kind in ("verify", "draft_propose", "draft_prefill"):
+            entry["draft"] = self._draft_model_dict()
+            entry["spec"] = self.spec_tokens
         _megakernel.record_manifest_entry(entry, self._manifest_dir)
 
     # -- executables -------------------------------------------------------
@@ -341,34 +493,136 @@ class InferenceEngine:
                 lengths, self._rep(np.zeros((B,), np.int32)))
         return self._aot(("decode",), kernel, args)
 
-    def _prefill_exec(self, bucket: int) -> Any:
-        cfg, cache = self.cfg, self.cache
+    def _prefill_exec(self, bucket: int, draft: bool = False) -> Any:
+        """Prefill executable, START-aware: ``start`` is the number of
+        already-cached positions (0 for a cold prefill; the shared
+        prefix length on a prefix-cache hit, so only the suffix runs
+        through the model), ``n_valid`` the real token count in the
+        padded ``tokens`` block — the last real token's logits are what
+        admission samples from.  ``draft=True`` builds the same program
+        over the draft model/cache (cold draft prefill on admission)."""
+        cfg = self._draft_cfg if draft else self.cfg
+        cache = self.draft_cache if draft else self.cache
+        params = self._draft_params if draft else self.params
         ps, pps, n_pages = (cache.page_size, cache.pages_per_slot,
                             cache.n_pages)
+        cap = cache.capacity
         L, H = cfg.n_layers, cfg.n_heads
         hd = cfg.d_model // H
 
-        def kernel(params, k_pages, v_pages, table_row, length, tokens):
+        def kernel(params, k_pages, v_pages, table_row, start, n_valid,
+                   tokens):
             k_view = k_pages[:, table_row].reshape(L, 1, pps * ps, H, hd)
             v_view = v_pages[:, table_row].reshape(L, 1, pps * ps, H, hd)
             logits, k_new, v_new = _transformer.forward_step(
-                params, tokens, jnp.zeros((1,), jnp.int32),
-                k_view, v_view, cfg)
-            i = jnp.arange(bucket)
-            page = table_row[0, i // ps]
-            flat = page * ps + i % ps  # pad positions land in trash
+                params, tokens, start, k_view, v_view, cfg)
+            idx = start[0] + jnp.arange(bucket, dtype=jnp.int32)
+            # Positions past the capacity (a deep suffix's padding) and
+            # pad positions whose page is unmapped both land in trash
+            # page 0; real positions are mapped by construction.
+            page = jnp.where(
+                idx < cap,
+                table_row[0, jnp.clip(idx // ps, 0, pps - 1)], 0)
+            flat = page * ps + idx % ps
             kf = k_pages.reshape(L, n_pages * ps, H, hd)
             vf = v_pages.reshape(L, n_pages * ps, H, hd)
             kf = kf.at[:, flat].set(k_new[:, 0])
             vf = vf.at[:, flat].set(v_new[:, 0])
-            return (logits[0, length[0] - 1],
+            return (logits[0, n_valid[0] - 1],
                     kf.reshape(k_pages.shape), vf.reshape(v_pages.shape))
 
-        args = (self.params, cache.k_pages, cache.v_pages,
+        args = (params, cache.k_pages, cache.v_pages,
                 self._rep(np.zeros((1, pps), np.int32)),
+                self._rep(np.zeros((1,), np.int32)),
                 self._rep(np.ones((1,), np.int32)),
                 self._rep(np.zeros((1, bucket), np.int32)))
-        return self._aot(("prefill", bucket), kernel, args)
+        key = ("draft_prefill" if draft else "prefill", bucket)
+        return self._aot(key, kernel, args)
+
+    def _verify_exec(self) -> Any:
+        """The speculative-decoding verify program: ONE donated target
+        dispatch over the ``spec_tokens + 1``-wide block ``[pending,
+        d_1..d_spec]`` for every slot, returning the full per-position
+        logits (the host applies the bitwise-greedy acceptance rule to
+        them — the same float32 argmax the non-speculative path runs,
+        so accepted tokens are exactly the non-speculative greedy
+        tokens) and scattering the block's KV.  Rejected positions'
+        entries are rolled back host-side (the write cursor simply does
+        not advance over them) and overwritten by the next iteration's
+        block before they could ever unmask."""
+        cfg, cache, B = self.cfg, self.cache, self.max_slots
+        W = self.spec_tokens + 1
+        ps, pps, n_pages = (cache.page_size, cache.pages_per_slot,
+                            cache.n_pages)
+        cap = cache.capacity
+        L, H = cfg.n_layers, cfg.n_heads
+        hd = cfg.d_model // H
+
+        def kernel(params, k_pages, v_pages, table, lengths, blocks):
+            k_view = k_pages[:, table].reshape(L, B, pps * ps, H, hd)
+            v_view = v_pages[:, table].reshape(L, B, pps * ps, H, hd)
+            logits, k_new, v_new = _transformer.forward_step(
+                params, blocks, lengths, k_view, v_view, cfg)
+            pos = (jnp.clip(lengths, 0, None)[:, None]
+                   + jnp.arange(W, dtype=jnp.int32)[None, :])
+            page = jnp.where(
+                pos < cap,
+                jnp.take_along_axis(table,
+                                    jnp.clip(pos // ps, 0, pps - 1),
+                                    axis=1), 0)
+            flat = page * ps + pos % ps
+            kf = k_pages.reshape(L, n_pages * ps, H, hd)
+            vf = v_pages.reshape(L, n_pages * ps, H, hd)
+            kf = kf.at[:, flat].set(k_new)
+            vf = vf.at[:, flat].set(v_new)
+            return (logits, kf.reshape(k_pages.shape),
+                    vf.reshape(v_pages.shape))
+
+        table, lengths = cache.device_tables()
+        args = (self.params, cache.k_pages, cache.v_pages, table,
+                lengths, self._rep(np.zeros((B, W), np.int32)))
+        return self._aot(("verify", W), kernel, args)
+
+    def _propose_exec(self) -> Any:
+        """The draft's propose program: ONE donated dispatch unrolling
+        ``spec_tokens`` greedy draft steps per slot
+        (models/transformer.speculative_propose) and scattering the
+        derived draft KV back into the draft's paged store."""
+        dcfg, dcache, B = self._draft_cfg, self.draft_cache, \
+            self.max_slots
+        m = self.spec_tokens
+        ps, pps, n_pages = (dcache.page_size, dcache.pages_per_slot,
+                            dcache.n_pages)
+        cap = dcache.capacity
+        L, H = dcfg.n_layers, dcfg.n_heads
+        hd = dcfg.d_model // H
+
+        def kernel(params, k_pages, v_pages, table, lengths, prev,
+                   pending):
+            k_view = k_pages[:, table].reshape(L, B, pps * ps, H, hd)
+            v_view = v_pages[:, table].reshape(L, B, pps * ps, H, hd)
+            sp = lengths - 1
+            proposals, kc, vc = _transformer.speculative_propose(
+                params, prev, pending, sp, k_view, v_view, dcfg, m)
+            pos = sp[:, None] + jnp.arange(m + 1, dtype=jnp.int32)[None]
+            page = jnp.where(
+                (pos >= 0) & (pos < cap),
+                jnp.take_along_axis(table,
+                                    jnp.clip(pos // ps, 0, pps - 1),
+                                    axis=1), 0)
+            flat = page * ps + jnp.where(pos >= 0, pos % ps, 0)
+            kf = k_pages.reshape(L, n_pages * ps, H, hd)
+            vf = v_pages.reshape(L, n_pages * ps, H, hd)
+            kf = kf.at[:, flat].set(kc)
+            vf = vf.at[:, flat].set(vc)
+            return (proposals, kf.reshape(k_pages.shape),
+                    vf.reshape(v_pages.shape))
+
+        table, lengths = dcache.device_tables()
+        args = (self._draft_params, dcache.k_pages, dcache.v_pages,
+                table, lengths, self._rep(np.zeros((B,), np.int32)),
+                self._rep(np.zeros((B,), np.int32)))
+        return self._aot(("draft_propose", m), kernel, args)
 
     def _bucket_for(self, n: int) -> int:
         n = max(2, min(n, self.capacity))
@@ -431,7 +685,7 @@ class InferenceEngine:
         tokens, so :meth:`follow` on worker ranks mirrors the cache and
         runs the identical executables in the same order."""
         mp = self._multiprocess()
-        admitted = self.scheduler.admit(now) if admit else []
+        admitted = self._admit(now) if admit else []
         if mp:
             self._bcast({"stop": False,
                          "admit": [(slot, list(req.prompt))
@@ -443,19 +697,31 @@ class InferenceEngine:
         # serve-loop thread — the only thread that may free KV slots —
         # and rides the step broadcast's evict list so follower cache
         # mirrors free the same pages (a handler-thread free would
-        # silently desync the fleet).
+        # silently desync the fleet).  _free_slot covers the draft's
+        # pages too (disconnect mid-speculation).
         cancelled = [s for s in self.scheduler.evict_cancelled()
                      if self.cache.length(s) >= 0]
         for slot in cancelled:
-            self.cache.free_slot(slot)
+            self._free_slot(slot)
         active = self.scheduler.active()
         # Page allocation (the host-side step that can raise — out of
         # pages) runs BEFORE the decode announcement: once a follower
         # reads a non-empty "decode" list it enters the compiled
         # program's collectives and cannot be reached by an abort
         # marker, so everything fallible on the host must happen first.
-        for slot, _ in active:
-            self.cache.ensure(slot, self.cache.length(slot))
+        # A speculative iteration writes spec_tokens positions past the
+        # current length (target) and spec_tokens - 1 (draft), so the
+        # whole block's pages map here; writes past the capacity drop
+        # into trash inside the kernels.  An all-temperature batch
+        # falls back to plain decode — sampled slots never consult
+        # proposals, so propose + wide verify would be pure overhead
+        # (the draft cache may lag for those slots; greedy slots only
+        # ever ride spec iterations, which advance both caches in
+        # lockstep, so their draft mirror stays exact).
+        spec = (self._draft_params is not None
+                and any(req.temperature <= 0.0 for _, req in active))
+        depth = self.spec_tokens if spec else 0
+        self._ensure_block(active, depth)
         if mp:
             # Post-prefill sync: first sampled tokens + which slots
             # survived into the decode batch (a max_new_tokens=1
@@ -464,11 +730,42 @@ class InferenceEngine:
                 "last": {s: int(self._last_token[s])
                          for s, _ in active},
                 "decode": [s for s, _ in active],
+                "spec": spec,
                 "evict": cancelled + [s for s, _ in admitted
                                       if self.cache.length(s) < 0]})
         if active:
-            self._decode_iteration(active)
+            if spec:
+                self._speculative_iteration(active)
+            else:
+                self._decode_iteration(active)
         return bool(admitted or active)
+
+    def _admit(self, now: Optional[int]) -> List[Tuple[int, Request]]:
+        """Headroom-gated admission: the scheduler prices each
+        candidate's prefill against the KV page budget (free list +
+        the prefix cache's reclaimable pages) BEFORE burning a slot,
+        so a request can never be admitted only to fail page
+        allocation mid-iteration.  ``admission_cost`` is exact about
+        prefix hits: referenced shared pages are free, reclaimable
+        ones cost their LRU slot.  Under the default sizing the gate
+        is a structural safety net — a free slot always implies
+        headroom — but it keeps overcommitted or future configs
+        honest (the pricing is pure: no refcounts move here)."""
+        return self.scheduler.admit(
+            now, page_budget=self.cache.free_pages(),
+            pages_needed=lambda req:
+                self.cache.admission_cost(req.prompt))
+
+    def _ensure_block(self, active, depth: int) -> None:
+        for slot, _ in active:
+            ln = self.cache.length(slot)
+            if ln < 0:
+                continue
+            self.cache.ensure(slot, min(ln + depth, self.capacity - 1))
+            if depth and self.draft_cache is not None:
+                self.draft_cache.ensure(
+                    slot, min(ln + depth - 1,
+                              self.draft_cache.capacity - 1))
 
     def _sample(self, req: Request, logits: np.ndarray) -> int:
         if req.temperature <= 0.0:
@@ -483,7 +780,21 @@ class InferenceEngine:
             (req.seed, len(req.prefix) + len(req.generated)))
         return int(rng.choice(len(p), p=p))
 
-    def _feed(self, slot: int, req: Request, token: int) -> None:
+    def _free_slot(self, slot: int) -> None:
+        """Release one slot's KV everywhere it exists: the target's
+        pages (prefix refcounts decrement inside ``free_slot``) AND the
+        draft's — a client disconnect mid-speculation must not strand
+        draft pages (hvd-chaos).  Idempotent like the underlying
+        frees."""
+        self.cache.free_slot(slot)
+        if self.draft_cache is not None:
+            self.draft_cache.free_slot(slot)
+
+    def _feed(self, slot: int, req: Request,
+              token: int) -> Optional[str]:
+        """Record one sampled/accepted token; returns the finish
+        reason when this token ended the sequence (the speculative
+        path stops feeding its block there), else None."""
         if not req.generated:
             req.t_first_token = time.perf_counter()
             _M_TTFT.observe(req.t_first_token - req.t_submit)
@@ -494,7 +805,7 @@ class InferenceEngine:
         reason = self.scheduler.feed(slot, token, expect=req)
         if reason is not None:
             req.t_done = time.perf_counter()
-            self.cache.free_slot(slot)  # idempotent vs the drain
+            self._free_slot(slot)  # idempotent vs the drain
             if _trace.enabled():
                 # hvd-trace serving span: the whole request lifetime
                 # (submit -> completion), reconstructed from the wall
@@ -510,23 +821,54 @@ class InferenceEngine:
                           "reason": reason})
         else:
             self._last_token[slot] = token
+        return reason
 
     def _prefill(self, slot: int, req: Request,
                  prompt: Optional[List[int]] = None) -> np.ndarray:
+        """Admission prefill.  With a prefix-cache hit the shared pages
+        map copy-free and ONLY the suffix runs through the model (the
+        KV a suffix prefill derives is bitwise-identical to a cold
+        full prefill's: every gemm is row-wise over M>=2 blocks, the
+        same discipline the prefill+decode ≡ non-incremental contract
+        already rides).  The completed prompt's full pages publish into
+        the index afterwards, so the NEXT request sharing the header
+        hits.  With a draft model, the draft prefills the full prompt
+        too (its own small forward — the draft has no prefix cache)."""
         prompt = list(req.prompt) if prompt is None else prompt
         n = len(prompt)
-        self.cache.begin_slot(slot, n)
-        bucket = self._bucket_for(n)
+        shared = self.cache.lookup_prefix(prompt)
+        n_shared = len(shared) * self.cache.page_size
+        self.cache.begin_slot(slot, n, prefix_pages=shared)
+        suffix = prompt[n_shared:]
+        bucket = self._bucket_for(len(suffix))
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = prompt
+        tokens[0, :len(suffix)] = suffix
         compiled = self._prefill_exec(bucket)
         with _oom.guard(f"serving/prefill/{bucket}"):
             last, kp, vp = compiled(
                 self.params, self.cache.k_pages, self.cache.v_pages,
                 self._rep(self.cache.table_row(slot)),
-                self._rep(np.asarray([n], np.int32)),
+                self._rep(np.asarray([n_shared], np.int32)),
+                self._rep(np.asarray([len(suffix)], np.int32)),
                 self._rep(tokens))
         self.cache.replace_pages(kp, vp)
+        self.cache.publish_prefix(slot, prompt)
+        if self._draft_params is not None:
+            self.draft_cache.begin_slot(slot, n)
+            dbucket = self._bucket_for(n)
+            dtokens = np.zeros((1, dbucket), np.int32)
+            dtokens[0, :n] = prompt
+            dcompiled = self._prefill_exec(dbucket, draft=True)
+            with _oom.guard(f"serving/draft_prefill/{dbucket}"):
+                _, dkp, dvp = dcompiled(
+                    self._draft_params, self.draft_cache.k_pages,
+                    self.draft_cache.v_pages,
+                    self._rep(self.draft_cache.table_row(slot)),
+                    self._rep(np.zeros((1,), np.int32)),
+                    self._rep(np.asarray([n], np.int32)),
+                    self._rep(dtokens))
+            self.draft_cache.replace_pages(dkp, dvp)
+        self._prev_token[slot] = prompt[-1]
         _M_PREFILLS.inc()
         return np.asarray(last)
 
@@ -563,6 +905,122 @@ class InferenceEngine:
     def _prefill_and_sample(self, slot: int, req: Request) -> None:
         last = self._prefill(slot, req)
         self._feed(slot, req, self._sample(req, last))
+
+    # -- speculative decoding ---------------------------------------------
+    def _spec_dispatch(self, slots: Sequence[int]):
+        """The speculative iteration's two dispatches — draft propose,
+        then target verify — shared verbatim by rank 0 and
+        :meth:`follow` so the fleet's page arrays stay identical.
+        Returns ``(proposals [B, spec_tokens], logits [B, spec_tokens
+        + 1, vocab])`` as numpy."""
+        B = self.max_slots
+        prev = np.zeros((B,), np.int32)
+        pending = np.zeros((B,), np.int32)
+        for s in slots:
+            prev[s] = self._prev_token[s]
+            pending[s] = self._last_token[s]
+        dtable, dlengths = self.draft_cache.device_tables()
+        compiled = self._propose_exec()
+        with _oom.guard(f"serving/draft_propose/{self.spec_tokens}"):
+            proposals, dk, dv = compiled(
+                self._draft_params, self.draft_cache.k_pages,
+                self.draft_cache.v_pages, dtable, dlengths,
+                self._rep(prev), self._rep(pending))
+        self.draft_cache.replace_pages(dk, dv)
+        props = np.asarray(proposals)
+        W = self.spec_tokens + 1
+        blocks = np.zeros((B, W), np.int32)
+        for s in slots:
+            blocks[s, 0] = pending[s]
+            blocks[s, 1:] = props[s]
+        table, lengths = self.cache.device_tables()
+        compiled = self._verify_exec()
+        with _oom.guard(f"serving/verify/{W}"):
+            logits, kp, vp = compiled(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                table, lengths, self._rep(blocks))
+        self.cache.replace_pages(kp, vp)
+        return props, np.asarray(logits)
+
+    def _speculative_iteration(self, active) -> None:
+        """One speculative iteration over ``active``: propose + verify
+        (two dispatches total — the draft's and the target's), then the
+        host-side bitwise-greedy acceptance.  For a greedy slot the
+        accepted tokens plus the correction/bonus token are EXACTLY the
+        tokens non-speculative greedy decode would emit (the verify
+        logits are bitwise-equal to the decode executable's at every
+        position — the M>=2 gemm discipline — and the acceptance rule
+        is the same float32 argmax), so the engine's bitwise contract
+        survives any draft, any acceptance pattern, any batch mix.  A
+        temperature slot samples from the block's first position only —
+        bitwise what the decode path would sample.  Rejected tail:
+        the write cursor (cache lengths) just does not advance over it;
+        the pages stay masked and the next block overwrites them."""
+        t0 = time.perf_counter()
+        m = self.spec_tokens
+        props, logits_np = self._spec_dispatch([s for s, _ in active])
+        fed: Dict[int, int] = {}
+        prev: Dict[int, int] = {}
+        advance: Dict[int, int] = {}
+        evicted: List[int] = []
+        for slot, req in active:
+            if req.temperature <= 0.0:
+                greedy = np.argmax(logits_np[slot], axis=-1)
+                accept = 0
+                while (accept < m
+                       and int(props[slot, accept])
+                       == int(greedy[accept])):
+                    accept += 1
+                emitted = [int(props[slot, j]) for j in range(accept)]
+                emitted.append(int(greedy[accept]))
+                # Greedy slots only: a temperature slot never consults
+                # the proposals (accept == 0 by construction), so
+                # counting it would dilute spec_acceptance_rate — the
+                # gauge operators size spec_tokens by.
+                self._spec_proposed += m
+                self._spec_accepted += accept
+                _M_SPEC_PROPOSED.inc(m)
+                if accept:
+                    _M_SPEC_ACCEPTED.inc(accept)
+            else:
+                emitted = [self._sample(req, logits_np[slot, 0])]
+                accept = 0
+            last_before = int(self._last_token[slot])
+            finished = False
+            for t in emitted:
+                if self._feed(slot, req, t) is not None:
+                    finished = True
+                    break
+            if finished or self.cache.length(slot) < 0:
+                evicted.append(slot)
+                continue
+            # The accepted inputs' KV is now valid: pending plus the
+            # accepted drafts (the bonus token is the new pending — its
+            # KV lands next iteration).
+            n_adv = 1 + accept
+            for _ in range(n_adv):
+                self.cache.advance(slot)
+                self.draft_cache.advance(slot)
+            self._prev_token[slot] = (emitted[-2] if len(emitted) >= 2
+                                      else last_before)
+            fed[slot] = int(self._last_token[slot])
+            prev[slot] = int(self._prev_token[slot])
+            advance[slot] = n_adv
+        if self._spec_proposed:
+            _M_SPEC_RATE.set(self._spec_accepted / self._spec_proposed)
+        if self._multiprocess():
+            self._bcast({"tokens": fed, "prev": prev,
+                         "advance": advance, "evict": evicted})
+        _M_DECODES.inc()
+        _M_TOKEN_LAT.observe(time.perf_counter() - t0)
+
+    @property
+    def spec_acceptance_rate(self) -> Optional[float]:
+        """Cumulative accepted/proposed draft-token ratio (None before
+        the first speculative iteration)."""
+        if not self._spec_proposed:
+            return None
+        return self._spec_accepted / self._spec_proposed
 
     # -- multi-host mirroring ---------------------------------------------
     def _multiprocess(self) -> bool:
@@ -612,35 +1070,54 @@ class InferenceEngine:
             self._last_token[int(slot)] = int(token)
         for slot in sync.get("evict", ()):
             if self.cache.length(int(slot)) >= 0:
-                self.cache.free_slot(int(slot))
+                self._free_slot(int(slot))
         decode = [int(s) for s in sync.get("decode", ())]
         if decode:
-            for slot in decode:
-                self.cache.ensure(slot, self.cache.length(slot))
-            table, lengths = self.cache.device_tables()
-            tokens = np.zeros((self.max_slots,), np.int32)
-            for slot in decode:
-                tokens[slot] = self._last_token[slot]
-            compiled = self._decode_exec()
-            with _oom.guard("serving/decode"):
-                _, kp, vp = compiled(
-                    self.params, self.cache.k_pages, self.cache.v_pages,
-                    table, lengths, self._rep(tokens))
-            self.cache.replace_pages(kp, vp)
+            spec = bool(sync.get("spec")) \
+                and self._draft_params is not None
+            self._ensure_block([(s, None) for s in decode],
+                               self.spec_tokens if spec else 0)
+            if spec:
+                # Same two dispatches as rank 0 (_spec_dispatch), then
+                # apply ITS acceptance results — host argmax is
+                # deterministic, but the broadcast keeps the mirror
+                # trivially exact.
+                self._spec_dispatch(decode)
+            else:
+                table, lengths = self.cache.device_tables()
+                tokens = np.zeros((self.max_slots,), np.int32)
+                for slot in decode:
+                    tokens[slot] = self._last_token[slot]
+                compiled = self._decode_exec()
+                with _oom.guard("serving/decode"):
+                    _, kp, vp = compiled(
+                        self.params, self.cache.k_pages,
+                        self.cache.v_pages, table, lengths,
+                        self._rep(tokens))
+                self.cache.replace_pages(kp, vp)
             fed = self._bcast(None)
             if fed.get("abort"):
-                # Rank 0's _decode_iteration died before broadcasting
-                # the sampled tokens; it freed everything — mirror
-                # that (and skip the advance: rank 0 never advanced).
+                # Rank 0's decode/speculative iteration died before
+                # broadcasting the sampled tokens; it freed everything
+                # — mirror that (and skip the advance: rank 0 never
+                # advanced).
                 self._free_all_slots()
                 return True
-            for slot in decode:
-                self.cache.advance(slot)
+            if spec:
+                for slot, n_adv in fed.get("advance", {}).items():
+                    for _ in range(int(n_adv)):
+                        self.cache.advance(int(slot))
+                        self.draft_cache.advance(int(slot))
+                for slot, token in fed.get("prev", {}).items():
+                    self._prev_token[int(slot)] = int(token)
+            else:
+                for slot in decode:
+                    self.cache.advance(slot)
             for slot, token in fed.get("tokens", {}).items():
                 self._last_token[int(slot)] = int(token)
             for slot in fed.get("evict", ()):
                 if self.cache.length(int(slot)) >= 0:
-                    self.cache.free_slot(int(slot))
+                    self._free_slot(int(slot))
         return True
 
     def stop_followers(self) -> None:
@@ -697,7 +1174,67 @@ class InferenceEngine:
     def _free_all_slots(self) -> None:
         for slot in range(self.max_slots):
             if self.cache.length(slot) >= 0:
-                self.cache.free_slot(slot)
+                self._free_slot(slot)
+
+    # -- shared-prefix index export / rebuild ------------------------------
+    def export_prefix_index(self) -> List[List[int]]:
+        """The prefix cache's maximal cached chains as token-id lists
+        (hash → token ids) — what ``elastic.ServingState.drain_commit``
+        persists next to the continuations so a relaunched fleet
+        rebuilds the shared pages instead of re-prefilling every
+        cached prefix cold."""
+        return self.cache.export_prefixes()
+
+    def seed_prefixes(self, prefixes: Sequence[Sequence[int]]) -> int:
+        """Rebuild exported prefixes into this engine's cache: each
+        chain prefills ONCE through a ghost page row (no decode slot
+        burned) and publishes with refcount zero — immediately
+        hittable, reclaimable under pressure.  Returns the number of
+        pages seeded."""
+        if not self.cache.prefix_enabled:
+            return 0
+        seeded = 0
+        ps = self.cache.page_size
+        for chain in prefixes:
+            tokens = [int(t) for t in chain]
+            n_pages = min(len(tokens) // ps,
+                          self.cache.pages_per_slot)
+            if n_pages <= 0:
+                continue
+            tokens = tokens[:n_pages * ps]
+            # +[0] sentinel: lookup_prefix only matches STRICT
+            # prefixes; the sentinel never reaches a full page, so
+            # this checks whether all n_pages are already cached.
+            if len(self.cache.lookup_prefix(tokens + [0])) >= n_pages:
+                continue
+            row = self.cache.alloc_ghost(n_pages)
+            n = len(tokens)
+            bucket = self._bucket_for(n)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :n] = tokens
+            try:
+                compiled = self._prefill_exec(bucket)
+                with _oom.guard(f"serving/prefill/{bucket}"):
+                    _, kp, vp = compiled(
+                        self.params, self.cache.k_pages,
+                        self.cache.v_pages, self._rep(row),
+                        self._rep(np.zeros((1,), np.int32)),
+                        self._rep(np.asarray([n], np.int32)),
+                        self._rep(toks))
+            except Exception as e:  # noqa: BLE001 — seeding is an
+                # optimization: one failed chain must neither strand
+                # its ghost pages (the sizing invariant would silently
+                # erode) nor abort the elastic restore that still has
+                # requests to resubmit after this.
+                self.cache.free_ghost(row)
+                _telemetry.exception_event(
+                    "serve-seed-prefix",
+                    f"dropping {n_pages}-page prefix seed: "
+                    f"{type(e).__name__}: {e}")
+                continue
+            self.cache.replace_pages(kp, vp)
+            seeded += self.cache.publish_ghost(row, tokens)
+        return seeded
 
     def _drain_and_finish(self, reason: str):
         """The shared eviction sequence (caller holds ``_drain_lock``):

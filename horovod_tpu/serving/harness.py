@@ -1,0 +1,82 @@
+"""Validation harness for hvd-spec (shared by bench.py and the test
+suite, so the CI gate and the unit tests assert ONE contract instead of
+two drifting copies).  Also a user-facing utility: point
+:func:`count_spec_dispatches` at an engine wired with a candidate draft
+to confirm the steady-state dispatch contract on your own model.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def zeroed_layer_params(params: dict):
+    """Zero every layer's residual contribution (attention output +
+    FFN output projections): the model's logits reduce to
+    ``ln_f(embed + pos) @ unembed``, independent of depth or width —
+    the construction behind :func:`agreement_pair`."""
+    import jax.numpy as jnp
+
+    layers = dict(params["layers"])
+    layers["wo"] = jnp.zeros_like(layers["wo"])
+    layers["w_out"] = jnp.zeros_like(layers["w_out"])
+    layers["b_out"] = jnp.zeros_like(layers["b_out"])
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def agreement_pair(target_cfg, draft_cfg, seed: int = 0):
+    """A ``(target_params, draft_params)`` pair whose greedy argmax
+    agrees EXACTLY at every position: both models' layer contributions
+    are zeroed (:func:`zeroed_layer_params`) and the draft shares the
+    target's embed/pos/ln_f/unembed halves, so their logits are
+    bitwise-identical while the draft still pays only its own (smaller)
+    layer stack.  Acceptance under the bitwise-greedy rule is therefore
+    deterministically 1.0 — the mechanism's upper bound, which is what
+    makes the bench's speculative speedup gate reproducible.  Requires
+    matching ``vocab_size``/``d_model``/``max_seq_len``."""
+    import jax
+
+    from ..models.transformer import init_transformer
+
+    if (draft_cfg.vocab_size != target_cfg.vocab_size
+            or draft_cfg.d_model != target_cfg.d_model):
+        raise ValueError(
+            "agreement_pair needs matching vocab_size and d_model "
+            "(the embed/unembed halves are shared)")
+    target = zeroed_layer_params(
+        init_transformer(jax.random.PRNGKey(seed), target_cfg))
+    draft = zeroed_layer_params(
+        init_transformer(jax.random.PRNGKey(seed + 1), draft_cfg))
+    for k in ("embed", "pos_embed", "ln_f", "unembed"):
+        draft[k] = target[k]
+    return target, draft
+
+
+def count_spec_dispatches(engine) -> Tuple[int, int, int]:
+    """Run ONE steady-state speculative iteration on ``engine`` (which
+    must have active slots — e.g. after a ``step()`` that admitted) and
+    return ``(propose_calls, verify_calls, eager_dispatches)``.  The
+    hvd-spec dispatch contract is ``(1, 1, 0)``: one draft propose, ONE
+    target verify, nothing eager — asserted by both the CI bench gate
+    and tests/test_speculative.py through this one implementation."""
+    from ..utils import xla_dispatch
+
+    calls = {"verify": 0, "propose": 0}
+    vkey = ("verify", engine.spec_tokens + 1)
+    pkey = ("draft_propose", engine.spec_tokens)
+    v_exec, p_exec = engine._exec[vkey], engine._exec[pkey]
+    engine._exec[vkey] = lambda *a: (
+        calls.__setitem__("verify", calls["verify"] + 1) or v_exec(*a))
+    engine._exec[pkey] = lambda *a: (
+        calls.__setitem__("propose", calls["propose"] + 1)
+        or p_exec(*a))
+    try:
+        with xla_dispatch.exact_scope():
+            with xla_dispatch.record(all_threads=True) as scope:
+                engine.step()
+            eager = scope.count
+    finally:
+        engine._exec[vkey], engine._exec[pkey] = v_exec, p_exec
+    return calls["propose"], calls["verify"], eager

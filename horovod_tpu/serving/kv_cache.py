@@ -26,12 +26,28 @@ axis with a ``NamedSharding`` — the SAME partition
 column-parallel), so a model served on its training mesh reuses the
 training layout and GSPMD partitions prefill/decode along heads with
 no code change here.
+
+**Shared-prefix page cache** (hvd-spec, docs/inference.md): completed
+prompt-prefix pages are hashed — a page-aligned CHAIN hash over the
+token ids, keyed by the engine's model/config fingerprint, so the hash
+of page ``j`` commits to every token before it — into a refcounted
+read-only index.  A new request whose prompt extends a cached prefix
+maps those pages into its page table copy-free (``begin_slot``'s
+``prefix_pages``) and prefills only the suffix; repeated system
+prompts, few-shot headers and RAG contexts become page-table lookups.
+Shared pages are never written (decode/verify scatters target
+positions ``>= length > shared coverage`` by construction) and never
+freed while referenced: ``free_slot`` decrements refcounts, and a page
+whose count reaches zero parks in an LRU of *reclaimable* cached pages
+— still index-hittable, recycled only when the free list runs dry.
 """
 
 from __future__ import annotations
 
+import hashlib
 import weakref
-from typing import List, Optional, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -52,10 +68,29 @@ from ..memory import ledger as _mem
 # dump's tail.
 _M_KV_FREE = _telemetry.gauge(
     "serving.kv_free_pages",
-    "KV pages on the free list (admission headroom)")
+    "KV pages available for allocation: free list + reclaimable "
+    "prefix-cache pages (admission headroom)")
 _M_KV_TOTAL = _telemetry.gauge(
     "serving.kv_total_pages",
     "allocatable KV pages (capacity; excludes the trash page)")
+_M_KV_RECLAIM = _telemetry.gauge(
+    "serving.kv_reclaimable_pages",
+    "unreferenced prefix-cache pages (reclaimed LRU-first when the "
+    "free list runs dry; counted inside kv_free_pages)")
+_M_PREFIX_CACHED = _telemetry.gauge(
+    "serving.prefix_cached_pages",
+    "pages currently held by the shared-prefix index (referenced + "
+    "reclaimable)")
+_M_PREFIX_HITS = _telemetry.counter(
+    "serving.prefix_hits",
+    "admissions that mapped at least one cached prefix page copy-free")
+_M_PREFIX_PAGES = _telemetry.counter(
+    "serving.prefix_pages_shared",
+    "cached prefix pages mapped into admitted slots (copy-free)")
+_M_PREFIX_BYTES = _telemetry.counter(
+    "serving.prefix_bytes_saved",
+    "KV bytes NOT recomputed thanks to prefix-cache hits (global "
+    "logical bytes of the shared pages)")
 
 
 class PagedKVCache:
@@ -72,9 +107,15 @@ class PagedKVCache:
     def __init__(self, n_layers: int, n_heads: int, head_dim: int,
                  max_slots: int, pages_per_slot: int, page_size: int,
                  dtype=jnp.float32, mesh=None,
-                 model_axis: str = MODEL_AXIS) -> None:
+                 model_axis: str = MODEL_AXIS,
+                 prefix_cache: bool = False, prefix_pages: int = 0,
+                 fingerprint: str = "",
+                 ledger_category: str = "serving.kv_pages") -> None:
         if pages_per_slot < 1 or page_size < 1:
             raise ValueError("pages_per_slot and page_size must be >= 1")
+        if prefix_pages < 0:
+            raise ValueError(f"prefix_pages must be >= 0, got "
+                             f"{prefix_pages}")
         self.n_layers = n_layers
         self.n_heads = n_heads
         self.head_dim = head_dim
@@ -82,10 +123,19 @@ class PagedKVCache:
         self.pages_per_slot = pages_per_slot
         self.page_size = page_size
         self.capacity = pages_per_slot * page_size  # per sequence
-        self.n_pages = 1 + max_slots * pages_per_slot  # +1: trash page
+        # +1: trash page; +prefix_pages: dedicated headroom for the
+        # shared-prefix index (the --prefix-pages planner what-if) so a
+        # busy fleet is not forced to thrash cached prefixes against
+        # live slots.
+        self.prefix_enabled = bool(prefix_cache)
+        self.prefix_pages = int(prefix_pages) if prefix_cache else 0
+        self.n_pages = (1 + max_slots * pages_per_slot
+                        + self.prefix_pages)
         self.dtype = dtype
         self.mesh = mesh
         self.model_axis = model_axis
+        self._fingerprint = fingerprint.encode()
+        self._ledger_category = ledger_category
 
         shape = (n_layers, self.n_pages, page_size, n_heads, head_dim)
         k = jnp.zeros(shape, dtype)
@@ -102,22 +152,48 @@ class PagedKVCache:
         # guarded_by: _lock
         self._table = np.zeros((max_slots, pages_per_slot), np.int32)
         self._lengths = np.full((max_slots,), -1, np.int32)
-        _M_KV_TOTAL.set(self.total_pages)
-        _M_KV_FREE.set(len(self._free))
+        # -- shared-prefix index (all guarded_by: _lock) ------------------
+        # chain hash -> physical page holding that page-aligned prefix's
+        # KV; _page_hash is the reverse map (page -> hash), _page_tokens
+        # keeps the token ids per entry for the elastic export,
+        # _refcount counts slots currently mapping a shared page, and
+        # _lru holds unreferenced cached pages in reclaim order.
+        self._index: Dict[bytes, int] = {}
+        self._page_hash: Dict[int, bytes] = {}
+        self._page_tokens: Dict[bytes, List[int]] = {}
+        self._refcount: Dict[int, int] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        if ledger_category == "serving.kv_pages":
+            _M_KV_TOTAL.set(self.total_pages)
+        self._set_page_gauges_locked()
         # hvd-mem: the page arrays are THE serving framework buffer —
         # account the bytes RESIDENT on this process (addressable
         # shards: a tp-sharded store holds global/tp per rank) for the
         # store's lifetime (keyed, released by gc: replace_pages swaps
         # same-shape donated outputs, so the figure is constant while
-        # the engine lives).
+        # the engine lives).  Dedicated prefix pages are partitioned
+        # into their own ledger category (the SAME per-page byte model
+        # memory/planner.prefix_pages_bytes predicts with), so
+        # plan-vs-ledger stays exact with a prefix reserve resident.
         self._ledger_key = id(self)
+        resident = _mem.resident_nbytes(k) + _mem.resident_nbytes(v)
+        # n_pages divides both factors of the array shape, so the
+        # partition is exact integer arithmetic.
+        self._page_resident_bytes = resident // self.n_pages
+        prefix_resident = self._page_resident_bytes * self.prefix_pages
         if _mem.enabled():
-            _mem.ledger.alloc("serving.kv_pages",
-                              _mem.resident_nbytes(k)
-                              + _mem.resident_nbytes(v),
+            _mem.ledger.alloc(self._ledger_category,
+                              resident - prefix_resident,
                               key=self._ledger_key)
-        weakref.finalize(self, _mem.ledger.free, "serving.kv_pages",
+            if prefix_resident:
+                _mem.ledger.alloc("serving.prefix_pages",
+                                  prefix_resident, key=self._ledger_key)
+        weakref.finalize(self, _mem.ledger.free, self._ledger_category,
                          key=self._ledger_key)
+        if prefix_resident:
+            weakref.finalize(self, _mem.ledger.free,
+                             "serving.prefix_pages",
+                             key=self._ledger_key)
 
     # -- sharding ----------------------------------------------------------
     def page_sharding(self) -> Optional[NamedSharding]:
@@ -137,17 +213,46 @@ class PagedKVCache:
         return NamedSharding(self.mesh,
                              P(None, None, None, self.model_axis, None))
 
+    # -- gauges ------------------------------------------------------------
+    def _set_page_gauges_locked(self) -> None:
+        # Only the primary (target) store owns the process-global
+        # serving.* page gauges; a draft store (its own ledger
+        # category) must not clobber them.
+        if self._ledger_category != "serving.kv_pages":
+            return
+        _M_KV_FREE.set(len(self._free) + len(self._lru))
+        _M_KV_RECLAIM.set(len(self._lru))
+        _M_PREFIX_CACHED.set(len(self._page_hash))
+
     # -- page management ---------------------------------------------------
-    def begin_slot(self, slot: int, n_tokens: int) -> None:
+    def begin_slot(self, slot: int, n_tokens: int,
+                   prefix_pages: Sequence[int] = ()) -> None:
         """Map pages for a freshly admitted sequence's first
-        ``n_tokens`` positions (the prompt) and set its length."""
+        ``n_tokens`` positions (the prompt) and set its length.
+        ``prefix_pages`` (from :meth:`lookup_prefix`) are mapped
+        COPY-FREE as the leading read-only pages: each gets a
+        reference (it leaves the reclaimable LRU while mapped) and
+        only the remainder allocates fresh pages — the suffix is all
+        the caller prefills."""
         with self._lock:
             if self._lengths[slot] >= 0:
                 raise ValueError(f"slot {slot} already active")
             self._table[slot] = 0
+            for j, page in enumerate(prefix_pages):
+                if self._page_hash.get(int(page)) is None:
+                    raise ValueError(
+                        f"page {page} is not a cached prefix page")
+                self._table[slot, j] = int(page)
+                self._ref_page_locked(int(page))
             self._lengths[slot] = 0
             self._ensure_locked(slot, n_tokens - 1)
             self._lengths[slot] = n_tokens
+            if prefix_pages:
+                _M_PREFIX_HITS.inc()
+                _M_PREFIX_PAGES.inc(len(prefix_pages))
+                _M_PREFIX_BYTES.inc(
+                    len(prefix_pages) * self.page_global_bytes)
+            self._set_page_gauges_locked()
 
     def ensure(self, slot: int, pos: int) -> None:
         """Map pages so position ``pos`` of ``slot`` is writable.
@@ -161,6 +266,42 @@ class PagedKVCache:
                 return
             self._ensure_locked(slot, pos)
 
+    def _alloc_page_locked(self) -> int:
+        """One allocatable page: free list first, then the LRU of
+        unreferenced cached prefix pages (refcount-aware eviction — a
+        REFERENCED shared page is never a candidate by construction:
+        it is absent from both pools)."""
+        if self._free:
+            return self._free.pop(0)
+        if self._lru:
+            page, _ = self._lru.popitem(last=False)
+            self._drop_index_locked(page)
+            return page
+        raise RuntimeError(
+            "paged KV cache out of pages (free list and prefix-cache "
+            "LRU both empty) — sizing guarantees this cannot happen "
+            "while every slot stays within pages_per_slot")
+
+    def _drop_index_locked(self, page: int) -> None:
+        key = self._page_hash.pop(page, None)
+        if key is not None:
+            self._index.pop(key, None)
+            self._page_tokens.pop(key, None)
+        self._refcount.pop(page, None)
+
+    def _ref_page_locked(self, page: int) -> None:
+        self._refcount[page] = self._refcount.get(page, 0) + 1
+        self._lru.pop(page, None)
+
+    def _unref_page_locked(self, page: int) -> None:
+        rc = self._refcount.get(page, 0) - 1
+        if rc <= 0:
+            self._refcount.pop(page, None)
+            self._lru[page] = None
+            self._lru.move_to_end(page)
+        else:
+            self._refcount[page] = rc
+
     def _ensure_locked(self, slot: int, pos: int) -> None:
         if pos >= self.capacity:
             raise ValueError(
@@ -168,13 +309,8 @@ class PagedKVCache:
                 f"{self.capacity}")
         for p in range(pos // self.page_size + 1):
             if self._table[slot, p] == 0:
-                if not self._free:
-                    raise RuntimeError(
-                        "paged KV cache out of pages (free list empty) "
-                        "— sizing guarantees this cannot happen while "
-                        "every slot stays within pages_per_slot")
-                self._table[slot, p] = self._free.pop(0)
-        _M_KV_FREE.set(len(self._free))
+                self._table[slot, p] = self._alloc_page_locked()
+        self._set_page_gauges_locked()
 
     def advance(self, slot: int) -> int:
         """One decoded token was written at the current length; map the
@@ -188,7 +324,10 @@ class PagedKVCache:
             return int(self._lengths[slot])
 
     def free_slot(self, slot: int) -> None:
-        """Evict: recycle the slot's pages onto the free list.
+        """Evict: recycle the slot's pages.  Refcount-aware: a page the
+        prefix index holds is UNREFERENCED (parked in the reclaimable
+        LRU when its count reaches zero — never put on the free list
+        while cached), every other page goes back on the free list.
         Idempotent — a second free of the same slot (the serve loop
         and a concurrent drain both evicting) is a no-op, never a
         double-insert into the free list."""
@@ -198,10 +337,212 @@ class PagedKVCache:
             for p in range(self.pages_per_slot):
                 page = int(self._table[slot, p])
                 if page != 0:
-                    self._free.append(page)
+                    if page in self._page_hash:
+                        self._unref_page_locked(page)
+                    else:
+                        self._free.append(page)
             self._table[slot] = 0
             self._lengths[slot] = -1
-            _M_KV_FREE.set(len(self._free))
+            self._set_page_gauges_locked()
+
+    # -- shared-prefix index -----------------------------------------------
+    @property
+    def page_global_bytes(self) -> int:
+        """GLOBAL logical KV bytes of one page (K + V, all layers) —
+        the byte model memory/planner.prefix_pages_bytes shares."""
+        return (2 * self.n_layers * self.page_size * self.n_heads
+                * self.head_dim * jnp.dtype(self.dtype).itemsize)
+
+    def _chain_hashes(self, tokens: Sequence[int],
+                      n_pages: int) -> List[bytes]:
+        """Chain hash per page boundary: ``h_j`` commits to the model
+        fingerprint AND every token of pages ``0..j`` — a hit on page
+        ``j`` implies the whole prefix matches, so the index needs no
+        token comparison on lookup."""
+        h = hashlib.sha256(self._fingerprint)
+        out: List[bytes] = []
+        ps = self.page_size
+        for j in range(n_pages):
+            h.update(np.asarray(tokens[j * ps:(j + 1) * ps],
+                                np.int32).tobytes())
+            out.append(h.digest())
+        return out
+
+    def lookup_prefix(self, tokens: Sequence[int]) -> List[int]:
+        """Physical pages of the longest cached page-aligned STRICT
+        prefix of ``tokens`` (at least one suffix token always remains
+        to prefill — the admission needs its logits to sample from).
+        Pure: no refcounts move until :meth:`begin_slot` maps the
+        pages, so the admission-headroom gate can call this freely."""
+        if not self.prefix_enabled or not tokens:
+            return []
+        max_pages = min((len(tokens) - 1) // self.page_size,
+                        self.pages_per_slot)
+        if max_pages <= 0:
+            return []
+        hashes = self._chain_hashes(tokens, max_pages)
+        pages: List[int] = []
+        with self._lock:
+            for key in hashes:
+                page = self._index.get(key)
+                if page is None:
+                    break
+                pages.append(page)
+        return pages
+
+    def admission_cost(self, tokens: Sequence[int]) -> int:
+        """How much of the :meth:`free_pages` budget admitting this
+        prompt consumes, EXACTLY: fresh pages for the unshared tail,
+        plus one unit per shared prefix page currently parked in the
+        reclaimable LRU (mapping it moves it to referenced — out of
+        the pool — while a page other slots already reference costs
+        nothing).  The scheduler's page-budget gate prices admissions
+        with this; under the default sizing it is a safety net (a
+        free slot always implies enough headroom), but the arithmetic
+        stays honest for overcommitted configs."""
+        if not tokens:
+            return 0
+        total = -(-len(tokens) // self.page_size)
+        if not self.prefix_enabled:
+            return total
+        max_pages = min((len(tokens) - 1) // self.page_size,
+                        self.pages_per_slot)
+        hashes = self._chain_hashes(tokens, max_pages) if max_pages \
+            else []
+        with self._lock:
+            shared = 0
+            lru_hits = 0
+            for key in hashes:
+                page = self._index.get(key)
+                if page is None:
+                    break
+                shared += 1
+                if page in self._lru:
+                    lru_hits += 1
+        return total - shared + lru_hits
+
+    def publish_prefix(self, slot: int, tokens: Sequence[int]) -> int:
+        """Insert ``slot``'s fully-prefilled prompt pages into the
+        index (pages entirely covered by ``tokens`` — pad garbage past
+        the prompt never lands in a published page).  Pages already
+        indexed (including the slot's own looked-up prefix) are
+        skipped; newly published pages become shared with the slot
+        holding the first reference.  Returns how many pages were newly
+        published."""
+        if not self.prefix_enabled:
+            return 0
+        ps = self.page_size
+        n_full = min(len(tokens) // ps, self.pages_per_slot)
+        if n_full <= 0:
+            return 0
+        hashes = self._chain_hashes(tokens, n_full)
+        published = 0
+        with self._lock:
+            if self._lengths[slot] < 0:
+                return 0
+            for j in range(n_full):
+                page = int(self._table[slot, j])
+                if page == 0:
+                    break
+                key = hashes[j]
+                if key in self._index or page in self._page_hash:
+                    continue
+                self._index[key] = page
+                self._page_hash[page] = key
+                self._page_tokens[key] = [int(t)
+                                          for t in tokens[:(j + 1) * ps]]
+                self._refcount[page] = self._refcount.get(page, 0) + 1
+                published += 1
+            if published:
+                self._set_page_gauges_locked()
+        return published
+
+    def alloc_ghost(self, n_pages: int) -> np.ndarray:
+        """A ``[1, pages_per_slot]`` table row of ``n_pages`` freshly
+        allocated pages bound to NO slot — the elastic seed path
+        (:meth:`publish_ghost`) prefills cached prefixes through it on
+        a relaunched engine without burning a decode slot."""
+        if not 0 < n_pages <= self.pages_per_slot:
+            raise ValueError(
+                f"ghost prefix needs 1..{self.pages_per_slot} pages, "
+                f"got {n_pages}")
+        row = np.zeros((1, self.pages_per_slot), np.int32)
+        with self._lock:
+            for j in range(n_pages):
+                row[0, j] = self._alloc_page_locked()
+        return row
+
+    def free_ghost(self, row: np.ndarray) -> None:
+        """Return a ghost row's pages to the free list WITHOUT
+        indexing them — the seed path's failure cleanup (a prefill
+        that raised must not strand allocated pages outside every
+        pool, or the sizing invariant silently erodes)."""
+        with self._lock:
+            for page in row[0]:
+                if int(page) != 0:
+                    self._free.append(int(page))
+            self._set_page_gauges_locked()
+
+    def publish_ghost(self, row: np.ndarray,
+                      tokens: Sequence[int]) -> int:
+        """Index the ghost row's prefilled pages with refcount zero
+        (straight into the reclaimable LRU — hittable, evictable).
+        Pages whose chain hash is already indexed go back on the free
+        list.  Returns the newly indexed page count."""
+        ps = self.page_size
+        n_pages = sum(1 for p in row[0] if p != 0)
+        n_full = min(len(tokens) // ps, n_pages)
+        hashes = self._chain_hashes(tokens, n_full)
+        published = 0
+        with self._lock:
+            for j in range(self.pages_per_slot):
+                page = int(row[0, j])
+                if page == 0:
+                    continue
+                key = hashes[j] if j < n_full else None
+                if key is not None and key not in self._index:
+                    self._index[key] = page
+                    self._page_hash[page] = key
+                    self._page_tokens[key] = [
+                        int(t) for t in tokens[:(j + 1) * ps]]
+                    self._lru[page] = None
+                    self._lru.move_to_end(page)
+                    published += 1
+                else:
+                    self._free.append(page)
+            self._set_page_gauges_locked()
+        return published
+
+    def export_prefixes(self) -> List[List[int]]:
+        """The cached prefixes as token-id lists, MAXIMAL chains only
+        (an entry that is a strict prefix of another cached entry is
+        implied by it — seeding the long chain republishes every page
+        boundary).  The elastic drain exports this so a relaunched
+        fleet rebuilds the shared pages instead of re-prefilling every
+        cached prefix cold."""
+        with self._lock:
+            chains = sorted((list(t) for t in self._page_tokens.values()),
+                            key=len, reverse=True)
+        out: List[List[int]] = []
+        for c in chains:
+            if not any(len(k) > len(c) and k[:len(c)] == c for k in out):
+                out.append(c)
+        return out
+
+    def reclaimable_pages(self) -> int:
+        """Unreferenced cached prefix pages — allocatable on demand, so
+        they count toward admission headroom."""
+        with self._lock:
+            return len(self._lru)
+
+    def prefix_stats(self) -> Dict[str, int]:
+        """Index occupancy for /healthz and tests."""
+        with self._lock:
+            return {
+                "cached_pages": len(self._page_hash),
+                "referenced_pages": len(self._refcount),
+                "reclaimable_pages": len(self._lru),
+            }
 
     def length(self, slot: int) -> int:
         with self._lock:
@@ -214,8 +555,12 @@ class PagedKVCache:
         return self.n_pages - 1
 
     def free_pages(self) -> int:
+        """Pages available for allocation: the free list PLUS the
+        unreferenced cached prefix pages (reclaimed LRU-first on
+        demand) — the honest admission-headroom figure /healthz and
+        the scheduler's page-budget gate consume."""
         with self._lock:
-            return len(self._free)
+            return len(self._free) + len(self._lru)
 
     def table_row(self, slot: int) -> np.ndarray:
         """One slot's page-table row, ``[1, pages_per_slot]`` (a copy —

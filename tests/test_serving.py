@@ -256,6 +256,119 @@ def test_kv_cache_sharding_requires_model_axis():
 
 
 # ---------------------------------------------------------------------------
+# Shared-prefix page cache (hvd-spec)
+# ---------------------------------------------------------------------------
+
+def _prefix_cache(**kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("pages_per_slot", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefix_cache", True)
+    kw.setdefault("fingerprint", "test-model")
+    return PagedKVCache(n_layers=1, n_heads=2, head_dim=8, **kw)
+
+
+def test_prefix_publish_lookup_chain_semantics():
+    c = _prefix_cache()
+    prompt = list(range(20))  # 2 full pages + 4 tokens
+    c.begin_slot(0, len(prompt))
+    assert c.publish_prefix(0, prompt) == 2
+    # Longest cached page-aligned STRICT prefix: the full 2 pages for
+    # an extending prompt, 1 page when only the first page matches,
+    # nothing for a diverging first page.
+    assert len(c.lookup_prefix(prompt + [50, 51])) == 2
+    assert len(c.lookup_prefix(prompt[:8] + [99] * 12)) == 1
+    assert c.lookup_prefix([99] + prompt) == []
+    # Strictness: a prompt that IS the cached prefix exactly keeps at
+    # least one suffix token to prefill.
+    assert len(c.lookup_prefix(prompt[:16])) == 1
+    # The chain hash commits to every earlier token: same page-2
+    # content after a different page 1 must miss.
+    assert len(c.lookup_prefix([98] * 8 + prompt[8:16] + [1])) == 0
+
+
+def test_prefix_refcount_lru_and_reclaim():
+    c = _prefix_cache(max_slots=2, pages_per_slot=4)
+    prompt = list(range(17))  # 2 full pages
+    c.begin_slot(0, len(prompt))
+    c.publish_prefix(0, prompt)
+    pages = c.lookup_prefix(prompt + [1])
+    stats = c.prefix_stats()
+    assert stats["cached_pages"] == 2
+    assert stats["referenced_pages"] == 2  # slot 0 holds them
+    assert stats["reclaimable_pages"] == 0
+    # A second slot maps them copy-free; refcounts go to 2.
+    c.begin_slot(1, len(prompt) + 3, prefix_pages=pages)
+    assert list(c._table[1][:2]) == pages
+    assert c.prefix_stats()["referenced_pages"] == 2
+    c.free_slot(0)
+    assert c.prefix_stats()["referenced_pages"] == 2  # slot 1 remains
+    c.free_slot(1)
+    stats = c.prefix_stats()
+    # Unreferenced but still cached: parked in the reclaimable LRU,
+    # counted as free headroom.
+    assert stats["referenced_pages"] == 0
+    assert stats["reclaimable_pages"] == 2
+    assert c.free_pages() == c.total_pages
+    # Pressure reclaims LRU pages (and drops their index entries) but
+    # NEVER a referenced one.
+    c.begin_slot(0, 32)  # all 4 pages of slot 0
+    c.begin_slot(1, 32)  # exhausts the free list + both LRU pages
+    assert c.prefix_stats()["cached_pages"] == 0
+    assert len(c.lookup_prefix(prompt + [1])) == 0
+
+
+def test_prefix_referenced_pages_never_reclaimed():
+    """Pressure reclaims only UNREFERENCED cached pages: with a ghost
+    chain parked in the LRU and a referenced shared page live, filling
+    the store consumes the LRU and leaves the referenced page (and
+    slot 0's mapping of it) untouched."""
+    c = _prefix_cache(max_slots=2, pages_per_slot=4)
+    prompt = list(range(9))  # 1 full page
+    c.begin_slot(0, len(prompt))
+    c.publish_prefix(0, prompt)          # page referenced by slot 0
+    c.ensure(0, 31)                      # slot 0 holds all 4 pages
+    ghost_tokens = list(range(60, 76))
+    c.publish_ghost(c.alloc_ghost(2), ghost_tokens)
+    assert c.prefix_stats()["reclaimable_pages"] == 2
+    assert c.free_pages() == 4           # 2 free-list + 2 reclaimable
+    shared_page = int(c._table[0][0])
+    c.begin_slot(1, 32)                  # needs 4 -> reclaims the LRU
+    stats = c.prefix_stats()
+    assert stats["reclaimable_pages"] == 0
+    assert stats["cached_pages"] == 1    # the referenced page survives
+    assert int(c._table[0][0]) == shared_page
+    assert c.lookup_prefix(ghost_tokens + [1]) == []
+
+
+def test_prefix_ghost_seed_roundtrip():
+    c = _prefix_cache()
+    tokens = list(range(16))  # exactly 2 pages
+    row = c.alloc_ghost(2)
+    assert c.publish_ghost(row, tokens) == 2
+    stats = c.prefix_stats()
+    assert stats["cached_pages"] == 2
+    assert stats["reclaimable_pages"] == 2  # refcount zero, hittable
+    assert len(c.lookup_prefix(tokens + [7])) == 2
+    # Export returns the maximal chain only.
+    assert c.export_prefixes() == [tokens]
+    # Re-publishing the same chain frees the duplicate pages back.
+    free_before = c.free_pages()
+    row2 = c.alloc_ghost(2)
+    assert c.publish_ghost(row2, tokens) == 0
+    assert c.free_pages() == free_before
+
+
+def test_prefix_disabled_cache_is_inert():
+    c = _prefix_cache(prefix_cache=False)
+    prompt = list(range(20))
+    c.begin_slot(0, len(prompt))
+    assert c.publish_prefix(0, prompt) == 0
+    assert c.lookup_prefix(prompt + [1]) == []
+    assert c.prefix_stats()["cached_pages"] == 0
+
+
+# ---------------------------------------------------------------------------
 # Incremental decode: the bitwise contract (model level)
 # ---------------------------------------------------------------------------
 
@@ -1053,3 +1166,166 @@ def test_serving_checkpoint_roundtrip(tmp_path):
     ref_eng.warm_start()
     assert (eng.generate([1, 2, 3], max_new_tokens=4)
             == ref_eng.generate([1, 2, 3], max_new_tokens=4))
+
+
+# ---------------------------------------------------------------------------
+# Shared-prefix cache at the engine level (hvd-spec)
+# ---------------------------------------------------------------------------
+
+def test_engine_prefix_hit_is_bitwise_and_saves_prefill():
+    """The tentpole gate: a prompt extending a cached prefix maps the
+    shared pages copy-free, prefills ONLY the suffix, and the
+    completion is bitwise-equal to the cache-off engine's (and the
+    non-incremental reference)."""
+    from horovod_tpu import telemetry as _telemetry
+
+    def counter(name):
+        return _telemetry.metrics().get(name, {}).get("value", 0)
+
+    header = list(range(1, 18))  # 17 tokens -> 2 full pages published
+    ext = header + [40, 41, 42]
+    # Ground truth: the non-incremental reference — a cache-off engine
+    # equals it by the standing contract (and bench.py's prefix_cache
+    # leg gates cache-on vs cache-off completions directly).
+    a_off = reference_rollout(header, 5, 32)
+    b_off = reference_rollout(ext, 5, 32)
+
+    on = make_engine(prefix_cache=True)
+    on.warm_start()
+    assert on.generate(list(header), max_new_tokens=5) == a_off
+    assert on.cache.prefix_stats()["cached_pages"] == 2
+    hits0 = counter("serving.prefix_hits")
+    pages0 = counter("serving.prefix_pages_shared")
+    # Capture the suffix prefill's width: with 16 tokens shared, the
+    # 4-token suffix rides the 4-bucket, not the 32-bucket.
+    widths = []
+    orig = on._prefill_exec
+
+    def spy(bucket, draft=False):
+        widths.append(bucket)
+        return orig(bucket, draft)
+
+    on._prefill_exec = spy
+    assert on.generate(list(ext), max_new_tokens=5) == b_off
+    on._prefill_exec = orig
+    assert counter("serving.prefix_hits") - hits0 == 1
+    assert counter("serving.prefix_pages_shared") - pages0 == 2
+    assert max(widths) <= 4  # 20-token prompt, 16 shared -> suffix 4
+
+
+def test_engine_prefix_refcounts_follow_slot_lifecycle():
+    eng = make_engine(prefix_cache=True)
+    eng.warm_start()
+    header = list(range(1, 18))
+    eng.generate(list(header), max_new_tokens=3)
+    assert eng.cache.prefix_stats()["referenced_pages"] == 0
+    req = eng.submit(header + [50], max_new_tokens=30)
+    eng.step()  # admitted: the shared pages are referenced
+    assert eng.cache.prefix_stats()["referenced_pages"] == 2
+    eng.run_until_idle()
+    req.result(0)
+    stats = eng.cache.prefix_stats()
+    assert stats["referenced_pages"] == 0
+    assert stats["cached_pages"] >= 2
+    assert eng.cache.free_pages() == eng.cache.total_pages
+
+
+def test_engine_prefix_cache_off_env(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_PREFIX_CACHE", "0")
+    eng = make_engine()
+    assert not eng.cache.prefix_enabled
+    monkeypatch.delenv("HVD_TPU_PREFIX_CACHE")
+    assert make_engine().cache.prefix_enabled
+
+
+def test_engine_seed_prefixes_rebuilds_bitwise_pages():
+    """seed_prefixes (the elastic rebuild path) produces pages a later
+    request hits copy-free — and the hit is bitwise-identical to a
+    cold engine's completion."""
+    header = list(range(1, 17))  # exactly 2 pages
+    ref = reference_rollout(header + [7, 8], 6, 32)
+
+    eng = make_engine(prefix_cache=True)
+    eng.warm_start()
+    assert eng.seed_prefixes([header]) == 2
+    assert eng.cache.prefix_stats()["cached_pages"] == 2
+    assert eng.generate(header + [7, 8], max_new_tokens=6) == ref
+    # Seeding an already-covered chain is a no-op.
+    assert eng.seed_prefixes([header]) == 0
+
+
+def test_scheduler_admit_defers_on_page_budget():
+    """Admission headroom (hvd-spec satellite): a head-of-queue request
+    whose prefill does not fit the page budget defers — strictly FIFO
+    (nothing behind it admits first), the slot is not burned, and
+    serving.admission_deferred counts it."""
+    from horovod_tpu import telemetry as _telemetry
+
+    def deferred():
+        return _telemetry.metrics().get(
+            "serving.admission_deferred", {}).get("value", 0)
+
+    s = ContinuousBatchingScheduler(max_slots=2, capacity=64)
+    big = s.submit(_req(prompt=list(range(40))))     # 5 pages @ 8
+    small = s.submit(_req(prompt=[1, 2, 3]))         # 1 page
+    need = {big.rid: 5, small.rid: 1}
+    before = deferred()
+    admitted = s.admit(page_budget=4,
+                       pages_needed=lambda r: need[r.rid])
+    assert admitted == []                 # head blocked => FIFO holds
+    assert deferred() - before == 1
+    assert s.queue_depth() == 2
+    # With headroom back, the original order admits.
+    admitted = s.admit(page_budget=8,
+                       pages_needed=lambda r: need[r.rid])
+    assert [r.rid for _, r in admitted] == [big.rid, small.rid]
+
+
+# ---------------------------------------------------------------------------
+# Elastic: prefix-index export/rebuild roundtrip
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_elastic_serving_state_prefix_roundtrip(tmp_path, monkeypatch):
+    """drain_commit exports the prefix index next to the
+    continuations; a relaunched fleet's sync() rebuilds the shared
+    pages (ghost prefills), so the FIRST post-relaunch request already
+    hits copy-free — and everything stays bitwise.  (slow: four warm
+    engines; the CI serving-bench job runs it unfiltered — tier-1
+    keeps the cheap seed_prefixes leg.)"""
+    from horovod_tpu import elastic
+    from horovod_tpu import telemetry as _telemetry
+
+    def hits():
+        return _telemetry.metrics().get(
+            "serving.prefix_hits", {}).get("value", 0)
+
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DIR", str(tmp_path))
+    header = list(range(1, 18))  # 2 full pages published
+    eng = make_engine(prefix_cache=True)
+    eng.warm_start()
+    ref_a = eng.generate(list(header), max_new_tokens=4)
+    assert eng.cache.prefix_stats()["cached_pages"] == 2
+    state = elastic.ServingState(eng)
+    mid = eng.submit(header + [60], max_new_tokens=6)
+    exported = state.drain_commit()
+    assert state.wait_committed()
+    assert exported and mid.finish_reason == FinishReason.DRAINED
+
+    fresh = make_engine(prefix_cache=True)
+    fresh.warm_start()
+    state2 = elastic.ServingState(fresh)
+    state2.sync()  # rebuilds pages AND resubmits the continuation
+    assert fresh.cache.prefix_stats()["cached_pages"] >= 2
+    pend = fresh.scheduler.pending()
+    assert len(pend) == 1
+    fresh.run_until_idle()
+    # The continuation finished exactly as the uninterrupted run.
+    uninterrupted = make_engine(prefix_cache=False)
+    uninterrupted.warm_start()
+    assert pend[0].result(0) == uninterrupted.generate(
+        header + [60], max_new_tokens=6)
+    # Replaying the original header is a copy-free hit, bitwise.
+    h0 = hits()
+    assert fresh.generate(list(header), max_new_tokens=4) == ref_a
+    assert hits() > h0
