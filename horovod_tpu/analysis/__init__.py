@@ -1,6 +1,6 @@
 """hvd-analyze — static + trace-time correctness tooling for horovod_tpu.
 
-Three cooperating passes (docs/analysis.md):
+Five cooperating passes (docs/analysis.md):
 
 * :mod:`.program` — trace-time collective-program signature verifier:
   :func:`verify_program` proves cross-rank agreement of the traced
@@ -10,15 +10,41 @@ Three cooperating passes (docs/analysis.md):
 * :mod:`.lint` — AST lint pass over the codebase itself
   (``python -m horovod_tpu.analysis [--strict] [paths]``): guarded_by
   lock discipline, blocking calls under locks, rank-conditioned
-  collectives.
+  collectives — plus the stale-waiver audit: a ``# lint: ok(...)``
+  waiver no pass still needs is itself a finding.
 * :mod:`.lockorder` — runtime lock-order (inversion) detector
   (``HVD_TPU_LOCK_CHECK=1``): every internal runtime lock is created
   through its factories; an acquisition closing a cycle in the global
   lock-order graph raises :class:`~.lockorder.LockOrderError`
   immediately, in whichever single-threaded test first exhibits the
   ordering.
+* :mod:`.races` — Eraser-style lockset data-race detector
+  (``HVD_TPU_RACE_CHECK=1``): ``# guarded_by:`` annotations become
+  tracking descriptors on the runtime's shared classes; an access
+  pattern no single lock protects raises
+  :class:`~.races.DataRaceError` naming the field, both threads, and
+  both stack tails.  The same switch arms :mod:`.threads` dynamic
+  role asserts (``# thread: <role>`` contracts).
+* :mod:`.donation` — donation-lifetime sanitizer
+  (``HVD_TPU_DONATION_CHECK=1`` for the runtime registry; the
+  post-donation-read rule runs in the CLI): stale reads of
+  ``donate_argnums`` buffers raise :class:`~.donation.DonationError`
+  naming the executable, argument index, and donation site instead of
+  XLA's opaque deletion error.
+
+The CLI (``python -m horovod_tpu.analysis``) runs every static rule —
+lint, thread-role, post-donation-read, stale-waiver — over the given
+paths; ``--strict`` (CI's ``lint-analysis`` job) exits 1 on any
+finding.
 """
 
+from typing import Dict, List
+
+from .donation import (  # noqa: F401
+    DonationError,
+    PoisonedBuffer,
+    guard_dispatch,
+)
 from .lint import Finding, lint_paths, lint_sources  # noqa: F401
 from .lockorder import (  # noqa: F401
     CheckedLock,
@@ -37,30 +63,74 @@ from .program import (  # noqa: F401
     record_collective,
     verify_program,
 )
+from .races import DataRaceError, race_checked  # noqa: F401
+from .threads import ThreadRoleError  # noqa: F401
+
+
+def analyze_sources(sources: Dict[str, str]) -> List[Finding]:
+    """Run every static pass — lint rules, thread-role,
+    post-donation-read — over one shared scan of ``{path: source}``,
+    then audit the waivers: a ``# lint: ok(...)`` line no pass used to
+    suppress a finding is reported as **stale-waiver** (waivers must
+    not outlive the finding they excuse)."""
+    from . import donation as _donation
+    from . import lint as _lint
+    from . import threads as _threads
+
+    infos = _lint.scan_sources(sources)
+    findings = _lint.lint_infos(infos)
+    findings += _threads.check_infos(infos)
+    findings += _donation.check_infos(infos)
+    for fi in infos.values():
+        for line, reason in sorted(fi.waivers.items()):
+            if line not in fi.used_waivers:
+                findings.append(Finding(
+                    fi.path, line, "stale-waiver",
+                    f"waiver `# lint: ok({reason})` suppresses nothing "
+                    f"— no rule fires on this line any more; delete "
+                    f"the waiver so a future regression here cannot "
+                    f"hide behind it"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def analyze_paths(paths: List[str]) -> List[Finding]:
+    from . import lint as _lint
+
+    sources: Dict[str, str] = {}
+    for path in _lint._iter_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                sources[path] = f.read()
+        except OSError:
+            continue
+    return analyze_sources(sources)
 
 
 def main(argv=None) -> int:
-    """CLI: lint the given paths (default: the horovod_tpu package)."""
+    """CLI: run every static pass over the given paths (default: the
+    horovod_tpu package)."""
     import argparse
     import os
     import sys
 
     parser = argparse.ArgumentParser(
         prog="python -m horovod_tpu.analysis",
-        description="Lock-discipline + SPMD-divergence linter "
-                    "(hvd-analyze pass 2).")
+        description="Static correctness passes: lock discipline, SPMD "
+                    "divergence, thread-role contracts, post-donation "
+                    "reads, stale waivers.")
     parser.add_argument("paths", nargs="*",
-                        help="files or directories to lint "
+                        help="files or directories to analyze "
                              "(default: the horovod_tpu package)")
     parser.add_argument("--strict", action="store_true",
                         help="exit 1 when any finding is reported")
     args = parser.parse_args(argv)
     paths = args.paths or [os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))]
-    findings = lint_paths(paths)
+    findings = analyze_paths(paths)
     for f in findings:
         print(f.render())
-    print(f"hvd-analyze lint: {len(findings)} finding(s) over "
+    print(f"hvd-analyze: {len(findings)} finding(s) over "
           f"{', '.join(paths)}", file=sys.stderr)
     if findings and args.strict:
         return 1

@@ -1,0 +1,365 @@
+"""Donation-lifetime sanitizer: catch use-after-donation on
+``donate_argnums`` buffers by name, not as XLA's opaque
+"Array has been deleted".
+
+Buffer donation is this runtime's core memory lever — the megakernel
+fusion groups, the serving decode/prefill executables, ZeRO/FSDP
+update steps, and the pipeline stages all donate their big operands so
+XLA reuses the HBM in place.  It is also the dominant historical bug
+class: the EF-residual TAKE fix, the pipeline jit-fallback-after-
+consumed fix, and the megakernel dropped-refs fix were all stale reads
+of an already-donated buffer, each diagnosed from a bare XLA deletion
+error with no clue WHICH executable consumed the array.  This module
+closes that gap twice over:
+
+**Static pass** (``python -m horovod_tpu.analysis --strict``): the
+**post-donation-read** rule flags a read of a local after it was
+passed at a donated position through a ``jit``/``pjit`` callable with
+``donate_argnums`` *in the same scope*.  Tracking is linear and
+best-effort by design: locals bound to a donating ``jax.jit`` (and
+``self._x`` slots assigned one) are followed; a call through one marks
+the ``Name`` arguments at donated positions consumed; rebinding
+(``params = step(params, batch)`` — the correct idiom) clears the
+mark.  Waive intentional reads with ``# lint: ok(<why>)``.
+
+**Runtime mode** (``HVD_TPU_DONATION_CHECK=1``): executors route
+donated dispatches through :func:`guard_dispatch`, which (1) pre-checks
+every to-be-donated argument against the registry of buffers donated
+earlier — handing an already-donated buffer to another executable
+raises :class:`DonationError` naming the ORIGINAL donation (executable
+label, argument index, donation site) — and (2) after the call,
+registers each donated buffer (weakref-finalized, so identity reuse
+after GC cannot alias) and bumps ``analysis.donation_poisoned``.
+:func:`check` is the point probe for hand-written re-read sites, and
+:class:`PoisonedBuffer` is a sentinel executors can store back into
+their own slots (a residual table, a page registry) so *any* attribute
+access on the dead slot raises the named error.  Errors flight-record
+with the standard metrics tail.  Zero overhead when disarmed: one env
+read per dispatch.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import threading
+import traceback
+import weakref
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import lint as _lint
+from .lint import Finding
+
+_ENV = "HVD_TPU_DONATION_CHECK"
+
+
+class DonationError(RuntimeError):
+    """A buffer was read (or re-dispatched) after being donated to an
+    XLA executable; the message names the executable, the argument
+    index, and the donation site."""
+
+
+def enabled() -> bool:
+    return os.environ.get(_ENV) == "1"
+
+
+# ---------------------------------------------------------------------------
+# Runtime registry
+
+# id(buf) -> (label, index, site); entries are weakref-finalized away
+# when the buffer is collected, so a recycled id cannot alias a dead
+# entry.  Plain dict + leaf lock: registrations are per-dispatch, not
+# per-element.
+_registry: Dict[int, Tuple[str, int, str]] = {}
+_registry_lock = threading.Lock()
+
+# Lifetime count of buffers ever registered as donated (telemetry pull
+# side; the registry dict itself shrinks as buffers are collected).
+_n_poisoned = 0
+
+
+def poison_count() -> int:
+    return _n_poisoned
+
+
+def _site_tail(limit: int = 4) -> str:
+    frames = [f for f in traceback.extract_stack(limit=limit + 4)
+              if "analysis/donation" not in f.filename.replace("\\", "/")]
+    return " <- ".join(f"{os.path.basename(f.filename)}:{f.lineno}"
+                       f"({f.name})" for f in reversed(frames[-limit:]))
+
+
+def _raise(label: str, index: int, site: str, context: str) -> None:
+    msg = (f"use-after-donation: {context} a buffer donated to "
+           f"{label!r} (argument {index}, donated at [{site}]); the "
+           f"backing HBM was reused in place — keep the executable's "
+           f"RETURN value instead of the consumed operand")
+    try:
+        from ..telemetry import flight as _flight
+
+        _flight.record("donation_error", label, index, context)
+        _flight.dump("donation-error", extra={
+            "executable": label, "arg_index": index,
+            "donation_site": site, "context": context,
+            "read_site": _site_tail()})
+    except Exception:  # noqa: BLE001 — forensics only
+        pass
+    raise DonationError(msg)
+
+
+class PoisonedBuffer:
+    """Sentinel an executor stores into its own slot after donating the
+    slot's buffer; any attribute access raises the named
+    :class:`DonationError` instead of XLA's deletion error."""
+
+    __slots__ = ("_label", "_index", "_site")
+
+    def __init__(self, label: str, index: int, site: str) -> None:
+        object.__setattr__(self, "_label", label)
+        object.__setattr__(self, "_index", index)
+        object.__setattr__(self, "_site", site)
+
+    def __getattr__(self, name: str):
+        _raise(object.__getattribute__(self, "_label"),
+               object.__getattribute__(self, "_index"),
+               object.__getattribute__(self, "_site"),
+               f"attribute read ({name!r}) of")
+
+    def __repr__(self) -> str:  # repr stays safe for logging
+        return (f"<PoisonedBuffer donated to "
+                f"{object.__getattribute__(self, '_label')!r} arg "
+                f"{object.__getattribute__(self, '_index')}>")
+
+
+def _entry_for(buf) -> Optional[Tuple[str, int, str]]:
+    with _registry_lock:
+        return _registry.get(id(buf))
+
+
+def check(buf, context: str = "read of") -> None:
+    """Point probe: raise :class:`DonationError` if ``buf`` was donated
+    through :func:`guard_dispatch` earlier (or is already deleted).
+    No-op when disarmed."""
+    if not enabled() or buf is None:
+        return
+    if isinstance(buf, PoisonedBuffer):
+        buf.shape  # raises with the slot's own donation facts
+    entry = _entry_for(buf)
+    if entry is not None:
+        _raise(entry[0], entry[1], entry[2], context)
+
+
+def register(buf, label: str, index: int,
+             site: Optional[str] = None) -> None:
+    """Record ``buf`` as donated to ``label`` at argument ``index``.
+    Buffers that cannot take a weakref (scalars, tracers) are skipped —
+    without finalization an id-keyed entry could alias a later
+    allocation."""
+    if buf is None:
+        return
+    site = site or _site_tail()
+    key = id(buf)
+    try:
+        def _drop(k=key):
+            with _registry_lock:
+                _registry.pop(k, None)
+
+        weakref.finalize(buf, _drop)
+    except TypeError:
+        return
+    global _n_poisoned
+    with _registry_lock:
+        _registry[key] = (label, index, site)
+        # Under the leaf lock, NOT a telemetry Counter: guard_dispatch
+        # may run under executor locks, so registration must not take
+        # the registry's — telemetry pulls this via its `analysis`
+        # collector (analysis.donation_poisoned gauge).
+        _n_poisoned += 1
+
+
+def guard_dispatch(label: str, fn, args: Sequence,
+                   donated: Iterable[int], kwargs: Optional[dict] = None):
+    """Run ``fn(*args, **kwargs)`` with donation bookkeeping: pre-check
+    each ``donated`` index (a stale buffer raises the ORIGINAL
+    donation's error before XLA sees it), then register the donated
+    arguments.  When disarmed this is a plain call."""
+    kwargs = kwargs or {}
+    if not enabled():
+        return fn(*args, **kwargs)
+    donated = [i for i in donated if 0 <= i < len(args)]
+    for i in donated:
+        check(args[i], context=f"re-dispatch (into {label!r} arg {i}) of")
+        deleted = getattr(args[i], "is_deleted", None)
+        if deleted is not None:
+            try:
+                stale = bool(deleted())
+            except Exception:  # noqa: BLE001 — non-array lookalikes
+                stale = False
+            if stale:
+                _raise(label, i, "<unknown (deleted outside the "
+                       "donation registry)>",
+                       f"dispatch (into {label!r} arg {i}) of")
+    out = fn(*args, **kwargs)
+    site = _site_tail()
+    for i in donated:
+        register(args[i], label, i, site=site)
+    return out
+
+
+def reset() -> None:
+    """Forget all registered donations (tests)."""
+    with _registry_lock:
+        _registry.clear()
+
+
+# ---------------------------------------------------------------------------
+# Static pass
+
+
+def _donate_positions(call: ast.Call) -> Optional[List[int]]:
+    """Donated positions of a ``jit``/``pjit`` call with a literal
+    ``donate_argnums``; None when not a donating jit."""
+    if _lint._terminal_name(call.func) not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return [v.value]
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, int):
+                    out.append(el.value)
+                else:
+                    return None  # non-literal: give up, stay silent
+            return out
+    return None
+
+
+def _target_key(node: ast.expr) -> Optional[str]:
+    """Trackable binding target: a local name, or a ``self.x`` slot
+    (keyed ``self.x``) for the AOT-handle idiom."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return f"self.{node.attr}"
+    return None
+
+
+class _FnWalker(ast.NodeVisitor):
+    """Linear per-function walk: follow donating-jit bindings, mark
+    Name args at donated positions consumed, flag later reads."""
+
+    def __init__(self, fi, func, findings: List[Finding]) -> None:
+        self.fi = fi
+        self.func = func
+        self.findings = findings
+        self.jitted: Dict[str, List[int]] = {}   # key -> donated args
+        # local -> (executable key, index, donation line)
+        self.consumed: Dict[str, Tuple[str, int, int]] = {}
+
+    def _clear(self, key: Optional[str]) -> None:
+        if key is not None:
+            self.consumed.pop(key, None)
+
+    def _handle_call(self, node: ast.Call) -> None:
+        self.visit(node.func)
+        key = _target_key(node.func)
+        donated = self.jitted.get(key) if key else None
+        if donated is None:
+            donated = _donate_positions(node)  # inline jit(...)(...) form
+            if donated is not None:
+                key = _lint._terminal_name(node.func) or "jit"
+        for arg in node.args:
+            self.visit(arg)
+        for kw in node.keywords:
+            self.visit(kw.value)
+        if donated:
+            for i in donated:
+                if i < len(node.args):
+                    name = node.args[i]
+                    if isinstance(name, ast.Name):
+                        self.consumed[name.id] = (key or "jit", i,
+                                                  node.lineno)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # The donating call itself may be the VALUE of an assignment
+        # (visit_Assign orchestrates that case); a bare call lands here.
+        self._handle_call(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call):
+            donated = _donate_positions(node.value)
+            if donated is not None:
+                # `step = jax.jit(f, donate_argnums=...)`: track the
+                # binding, don't treat the jit() call as a dispatch.
+                for t in node.targets:
+                    k = _target_key(t)
+                    if k:
+                        self.jitted[k] = donated
+                        self._clear(k)
+                return
+            self._handle_call(node.value)
+        else:
+            self.visit(node.value)
+        for t in node.targets:
+            self._clear(_target_key(t))
+            if not isinstance(t, ast.Name):
+                self.visit(t)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        self.visit(node.target)  # reads before writing
+        self._clear(_target_key(node.target))
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            if isinstance(node.value, ast.Call):
+                self._handle_call(node.value)
+            else:
+                self.visit(node.value)
+            self._clear(_target_key(node.target))
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load) and node.id in self.consumed:
+            key, idx, line = self.consumed[node.id]
+            if _lint.waiver_hit(self.fi, node.lineno):
+                return
+            self.findings.append(Finding(
+                self.fi.path, node.lineno, "post-donation-read",
+                f"{node.id!r} is read after being donated to {key}() "
+                f"(donate_argnums position {idx}, donated at line "
+                f"{line}, in {self.func.name}); XLA reused its buffer "
+                f"— use the executable's return value, or waive with "
+                f"`# lint: ok(...)` if the read is pre-dispatch by "
+                f"construction"))
+            # One finding per donation; a fixed read usually fixes all.
+            self.consumed.pop(node.id, None)
+        elif isinstance(node.ctx, ast.Store):
+            self._clear(node.id)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is self.func:
+            self.generic_visit(node)
+        # Nested defs execute later: separate walk, fresh state.
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def check_infos(infos: Dict[str, "_lint._FileInfo"]) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in infos.values():
+        funcs = [n for n in ast.walk(fi.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for func in funcs:
+            _FnWalker(fi, func, findings).generic_visit(func)
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings
+
+
+def check_sources(sources: Dict[str, str]) -> List[Finding]:
+    return check_infos(_lint.scan_sources(sources))

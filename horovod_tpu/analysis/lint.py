@@ -28,7 +28,12 @@ shipped (see CHANGES.md) or that the reference could only discover as a
   enter the collective, the rest stall for 60 s then die).
 
 A finding line may carry ``# lint: ok(<why>)`` to waive it — the waiver
-text is the audit trail.
+text is the audit trail.  Waivers are themselves audited: a waiver
+comment on a line that no longer triggers ANY rule (of any pass — this
+one, thread-role, or post-donation-read) is reported as a
+**stale-waiver** finding by :func:`horovod_tpu.analysis.analyze_sources`
+so dead waivers cannot accumulate silently and mask a future
+regression on the same line.
 """
 
 from __future__ import annotations
@@ -94,6 +99,21 @@ class _FileInfo:
     producers: Dict[str, str] = field(default_factory=dict)  # fn -> class
     # Module-level singletons: `_state = _GlobalState()` → var -> class.
     module_vars: Dict[str, str] = field(default_factory=dict)
+    # Waiver comments: line -> reason, and the subset a rule (of any
+    # pass) actually suppressed — the difference is the stale-waiver
+    # report.
+    waivers: Dict[int, str] = field(default_factory=dict)
+    used_waivers: Set[int] = field(default_factory=set)
+
+
+def waiver_hit(fi: "_FileInfo", line: int) -> bool:
+    """True (and marks the waiver used) when ``line`` carries a
+    ``# lint: ok(...)`` waiver.  Shared by every static pass so the
+    stale-waiver audit sees cross-pass usage."""
+    if line in fi.waivers:
+        fi.used_waivers.add(line)
+        return True
+    return False
 
 
 def _terminal_name(node: ast.AST) -> Optional[str]:
@@ -150,6 +170,10 @@ def _scan_file(path: str, source: str) -> Optional[_FileInfo]:
     comments, own_line = _collect_comments(source)
     info = _FileInfo(path=path, tree=tree, comments=comments,
                      own_line=own_line)
+    for line, text in comments.items():
+        m = _WAIVER_RE.search(text)
+        if m:
+            info.waivers[line] = m.group(1)
     for node in ast.walk(tree):
         if isinstance(node, ast.ClassDef):
             ci = _ClassInfo(name=node.name)
@@ -220,8 +244,7 @@ class _RuleWalker(ast.NodeVisitor):
     # -- helpers -----------------------------------------------------------
 
     def _waived(self, line: int) -> bool:
-        text = self.fi.comments.get(line, "")
-        return bool(_WAIVER_RE.search(text))
+        return waiver_hit(self.fi, line)
 
     def _emit(self, node: ast.AST, rule: str, message: str) -> None:
         if not self._waived(node.lineno):
@@ -357,24 +380,38 @@ def _walk_functions(fi: _FileInfo, registry: Dict[str, _ClassInfo],
     visit_body(fi.tree.body, None)  # type: ignore[attr-defined]
 
 
-def lint_sources(sources: Dict[str, str]) -> List[Finding]:
-    """Lint a {path: source} mapping; annotations and producer functions
-    are resolved across the whole set."""
-    infos = [fi for fi in (_scan_file(p, s) for p, s in sorted(
-        sources.items())) if fi is not None]
+def scan_sources(sources: Dict[str, str]) -> Dict[str, "_FileInfo"]:
+    """Parse a {path: source} mapping into per-file scan info (comments,
+    annotations, waivers).  The other static passes (thread-role,
+    post-donation-read) and the stale-waiver audit run over the same
+    scan so waiver usage aggregates across passes."""
+    return {fi.path: fi
+            for fi in (_scan_file(p, s) for p, s in sorted(sources.items()))
+            if fi is not None}
+
+
+def lint_infos(infos: Dict[str, "_FileInfo"]) -> List[Finding]:
+    """Run the three lint rules over pre-scanned files (marking used
+    waivers on each :class:`_FileInfo` as a side effect)."""
     registry: Dict[str, _ClassInfo] = {}
     producers: Dict[str, str] = {}
-    for fi in infos:
+    for fi in infos.values():
         registry.update(fi.classes)
-    for fi in infos:
+    for fi in infos.values():
         for fn, cls in fi.producers.items():
             if cls in registry:
                 producers[fn] = cls
     findings: List[Finding] = []
-    for fi in infos:
+    for fi in infos.values():
         _walk_functions(fi, registry, producers, findings)
     findings.sort(key=lambda f: (f.path, f.line))
     return findings
+
+
+def lint_sources(sources: Dict[str, str]) -> List[Finding]:
+    """Lint a {path: source} mapping; annotations and producer functions
+    are resolved across the whole set."""
+    return lint_infos(scan_sources(sources))
 
 
 def _iter_py_files(paths: List[str]) -> List[str]:

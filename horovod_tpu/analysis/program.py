@@ -39,6 +39,7 @@ import os
 import threading
 from collections import deque
 from typing import Dict, List, NamedTuple, Optional, Tuple
+from . import races as _races
 
 # Cap on retained records: verification aligns on absolute sequence
 # numbers, so a long-running job keeps a sliding window instead of the
@@ -353,6 +354,7 @@ def program_check_enabled() -> bool:
     return os.environ.get("HVD_TPU_VERIFY_PROGRAM") == "1"
 
 
+@_races.race_checked
 class ProgramTracker:
     """Per-rank request streams as the coordinator's negotiation path
     sees them.  ``feed`` appends one request's signature and compares it

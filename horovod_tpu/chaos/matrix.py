@@ -749,6 +749,13 @@ def _child_env(s: Scenario, faulted: bool,
     if faulted and s.spec:
         env["HVD_TPU_FAULTS"] = s.spec
     env.setdefault("JAX_PLATFORMS", "cpu")
+    # hvd-race: fleet children run with the data-race detector and
+    # donation sanitizer armed (like HVD_TPU_LOCK_CHECK via env
+    # inheritance from conftest) — chaos is exactly where cross-thread
+    # interleavings and recovery-path stale reads surface.
+    env.setdefault("HVD_TPU_LOCK_CHECK", "1")
+    env.setdefault("HVD_TPU_RACE_CHECK", "1")
+    env.setdefault("HVD_TPU_DONATION_CHECK", "1")
     if s.kind == "local":
         flags = [f for f in env.get("XLA_FLAGS", "").split()
                  if not f.startswith(

@@ -42,6 +42,7 @@ from typing import Any, Optional
 import jax
 
 from ..analysis import lockorder as _lockorder
+from ..analysis import races as _races
 
 # Name of the one-dimensional mesh axis all Horovod-style collectives run
 # over.  Mirrors the single flat rank space of MPI_COMM_WORLD.
@@ -63,6 +64,7 @@ class NotInitializedError(RuntimeError):
         )
 
 
+@_races.race_checked
 @dataclass
 class _GlobalState:
     """Mutable singleton state guarded by ``lock`` (coarse, like the

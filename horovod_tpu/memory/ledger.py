@@ -52,6 +52,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
+from ..analysis import races as _races
 from ..telemetry import flight as _flight
 
 _M_LEAKS = _telemetry.counter(
@@ -75,6 +76,7 @@ CATEGORIES = (
 )
 
 
+@_races.race_checked
 class MemoryLedger:
     """Byte ledger with per-category current/peak and per-step total
     watermarks.  The lock is a leaf on the hvd-analyze lock-order graph

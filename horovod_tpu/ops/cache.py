@@ -72,6 +72,7 @@ from . import wire
 from .wire import Request, Response, ResponseType
 from ..analysis import lockorder as _lockorder
 from ..analysis import program as _program
+from ..analysis import races as _races
 from ..telemetry import flight as _flight
 
 # Retired epochs kept for stale-bit downgrade resolution.  Bits flow at
@@ -236,6 +237,7 @@ class CacheStats:
     inserts: int = 0
 
 
+@_races.race_checked
 class ResponseCache:
     """One rank's replica of the negotiation response cache.
 

@@ -53,6 +53,7 @@ from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..analysis import lockorder as _lockorder
 from ..analysis import program as _program
+from ..analysis import threads as _athreads
 from .. import chaos as _chaos
 from ..core import compat as _compat
 from ..core import state as _state
@@ -60,6 +61,7 @@ from ..core.state import REPLICA_AXIS
 from . import compression as _compression
 from . import megakernel as _megakernel
 from . import wire
+from ..analysis import races as _races
 from .wire import ReduceOp, Request, RequestType, Response, ResponseType
 
 # Public reduction-operator constants (≙ the post-v0.13 hvd.Average /
@@ -1240,6 +1242,7 @@ class _QueuedOp:
     t_submit_mono: float = 0.0
 
 
+@_races.race_checked
 class _OpQueue:
     """Pending async collectives awaiting (possibly fused) execution.
 
@@ -1292,11 +1295,12 @@ _drain_lock = _lockorder.make_lock("collective._drain_lock")
 TICK_SECONDS = 0.005
 
 
-def _background_loop(stop_event: threading.Event) -> None:
+def _background_loop(stop_event: threading.Event) -> None:  # thread: drain
     """≙ BackgroundThreadLoop (operations.cc:1167-1475): drain the async op
     queue on a fixed tick so ``*_async`` collectives make progress even if
     the caller never polls.  The period is runtime-adjustable
     (HOROVOD_CYCLE_TIME / the autotuner)."""
+    _athreads.set_role("drain")
     import traceback
 
     st = _state.global_state()

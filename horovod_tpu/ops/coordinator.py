@@ -44,6 +44,7 @@ from .wire import (DataType, Request, RequestType, Response, ResponseType)
 from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
 from ..analysis import program as _program
+from ..analysis import races as _races
 from ..native import lib as _native
 from ..telemetry import flight as _flight
 
@@ -80,6 +81,7 @@ def _withdraw_message(name: str, rank: int) -> str:
             f"all ranks.")
 
 
+@_races.race_checked
 class PyCoordinator:
     """Pure-Python coordinator (executable spec for native/coordinator.cc).
 
@@ -559,6 +561,7 @@ class NativeCoordinator:
             self._ptr = None
 
 
+@_races.race_checked
 class Coordinator:
     """Facade selecting the native coordinator when built, Python otherwise,
     and layering the timeline + stderr stall reporting over either.

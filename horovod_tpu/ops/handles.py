@@ -28,6 +28,7 @@ import jax
 
 from .. import telemetry as _telemetry
 from ..native import lib as _native
+from ..analysis import races as _races
 
 # Handle churn counters (pool DEPTH is the handles.live gauge, read
 # pull-side from live_count() by the runtime collector).
@@ -53,6 +54,7 @@ class Handle:
         self.cache_hit = False
 
 
+@_races.race_checked
 class HandleManager:
     """Allocates integer handles for async collectives.
 

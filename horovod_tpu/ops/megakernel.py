@@ -83,6 +83,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..analysis import donation as _donation
 from ..analysis import lockorder as _lockorder
 from ..analysis import program as _program
 from ..core import compat as _compat
@@ -1159,14 +1160,22 @@ def launch(spec: GroupSpec, mesh, values: Sequence,
                                                    spec.dtype))
     trace_t0 = time.monotonic() if trace_on else 0.0
 
+    # hvd-race donation sanitizer: every launch routes through the
+    # registry — re-dispatching a buffer a previous launch donated
+    # raises a DonationError naming THAT launch, and this launch's
+    # donated inputs are registered afterwards (HVD_TPU_DONATION_CHECK).
+    donated_idx = tuple(i for i, d in enumerate(mask) if d)
+
     def dispatch():
         # XLA compiles on the cold executable's FIRST dispatch; time
         # exactly that call (one perf_counter pair, cold path only) so
         # megakernel.compile_seconds reports real compilation cost.
         if not cold:
-            return fn(*values)
+            return _donation.guard_dispatch(
+                _launch_name(spec), fn, values, donated_idx)
         t0 = time.perf_counter()
-        out = fn(*values)
+        out = _donation.guard_dispatch(
+            _launch_name(spec), fn, values, donated_idx)
         with _lock:
             stats.compile_seconds += time.perf_counter() - t0
         return out

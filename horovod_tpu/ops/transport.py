@@ -65,6 +65,8 @@ from .. import chaos as _chaos
 from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..analysis import lockorder as _lockorder
+from ..analysis import threads as _athreads
+from ..analysis import races as _races
 from ..telemetry import flight as _flight
 from ..trace import clock as _trace_clock
 from ..utils.retry import BackoffPolicy
@@ -564,6 +566,7 @@ class _PeerSession:
     covers: set = field(default_factory=set)
 
 
+@_races.race_checked
 class ControllerTransport:
     """Rank 0: accepts one connection per worker, feeds their Requests into
     the in-process coordinator, broadcasts Response lists to everyone."""
@@ -767,7 +770,8 @@ class ControllerTransport:
             pass
 
     # -- session-resume listener (hvd-chaos reconnect) ---------------------
-    def _accept_loop(self) -> None:
+    def _accept_loop(self) -> None:  # thread: accept
+        _athreads.set_role("accept")
         try:
             self._accept_loop_inner()
         except Exception:
@@ -1071,7 +1075,8 @@ class ControllerTransport:
         except OSError:
             pass
 
-    def _serve(self, rank: int, conn: socket.socket) -> None:
+    def _serve(self, rank: int, conn: socket.socket) -> None:  # thread: rx
+        _athreads.set_role("rx")
         # An unhandled exception on a receive thread silently kills the
         # control plane for that worker; dump the flight ring naming
         # the thread before the (daemon) thread dies.
@@ -1633,6 +1638,7 @@ class ControllerTransport:
         self._srv.close()
 
 
+@_races.race_checked
 class WorkerTransport:
     """Ranks 1..N-1: one connection to the controller; sends Requests,
     receives Response lists into a queue the local drain loop empties."""
@@ -1777,7 +1783,8 @@ class WorkerTransport:
                 self._broken = True
                 _wake_close(sock)
 
-    def _recv_loop(self) -> None:
+    def _recv_loop(self) -> None:  # thread: rx
+        _athreads.set_role("rx")
         # Mirror of the controller's receive-thread guard: dump the
         # flight ring before an unhandled exception kills the thread.
         try:

@@ -80,6 +80,8 @@ from .. import chaos as _chaos
 from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..analysis import lockorder as _lockorder
+from ..analysis import threads as _athreads
+from ..analysis import races as _races
 from ..telemetry import flight as _flight
 from .wire import Request, Response, ResponseType
 
@@ -520,6 +522,7 @@ class _Pull:
     sent: bool = False
 
 
+@_races.race_checked
 class TreeWorkerTransport(T.WorkerTransport):
     """A non-root rank under the tree overlay.
 
@@ -726,7 +729,8 @@ class TreeWorkerTransport(T.WorkerTransport):
                 self._drop_link(link, f"relay send failed: {e}")
 
     # -- upward relay (child rx threads) -----------------------------------
-    def _child_rx(self, link: _ChildLink) -> None:
+    def _child_rx(self, link: _ChildLink) -> None:  # thread: rx
+        _athreads.set_role("rx")
         try:
             self._child_rx_inner(link)
         except Exception:
@@ -990,7 +994,8 @@ class TreeWorkerTransport(T.WorkerTransport):
             # root's pull of the live members' snapshots.
             self._pull_flush(kind, rnd)
 
-    def _tick_loop(self, tick: float) -> None:
+    def _tick_loop(self, tick: float) -> None:  # thread: ticker
+        _athreads.set_role("ticker")
         while not self._closing:
             time.sleep(tick)
             try:

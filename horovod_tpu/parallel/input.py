@@ -55,6 +55,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import chaos as _chaos
 from .. import telemetry as _telemetry
 from .. import trace as _trace
+from ..analysis import threads as _analysis_threads
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
 from ..memory import ledger as _mem
@@ -134,7 +135,8 @@ class PrefetchIterator:
         self._thread.start()
 
     # -- stager thread -----------------------------------------------------
-    def _stage_loop(self) -> None:
+    def _stage_loop(self) -> None:  # thread: stager
+        _analysis_threads.set_role("stager")
         it = iter(self._source)
         try:
             while not self._stop.is_set():
@@ -248,6 +250,19 @@ class PrefetchIterator:
             pass
         if self._thread.is_alive():
             self._thread.join(timeout=5.0)
+        # Drain AGAIN after the join: a stager parked inside its bounded
+        # put() can land one final charged batch in the window between
+        # the drain above emptying the queue and the stop-flag re-check
+        # — the put succeeds, the stager exits without freeing, and the
+        # charge would leak into whichever test asserts the
+        # "input.prefetch" category drains to zero.
+        try:
+            while True:
+                item = self._q.get_nowait()
+                if isinstance(item, _Staged) and item.nbytes:
+                    _mem.ledger.free("input.prefetch", item.nbytes)
+        except queue.Empty:
+            pass
         _M_DEPTH.set(0)
 
     def __enter__(self) -> "PrefetchIterator":

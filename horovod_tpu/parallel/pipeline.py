@@ -83,6 +83,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .. import telemetry as _telemetry
+from ..analysis import donation as _donation
 from ..core import compat as _compat
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
@@ -345,12 +346,18 @@ class _AotProgram:
     compiled-call failure) falls back to the jit wrapper, which
     recompiles transparently — semantics identical to plain jit."""
 
-    __slots__ = ("name", "_fn", "_compiled")
+    __slots__ = ("name", "_fn", "_compiled", "_donate")
 
-    def __init__(self, name: str, fn) -> None:
+    def __init__(self, name: str, fn, donate: Tuple[int, ...] = ()) -> None:
         self.name = name
         self._fn = fn
         self._compiled = None
+        # hvd-race donation sanitizer: the stage's donated positions —
+        # every dispatch routes through the registry so a stale
+        # re-dispatch of a consumed activation/state buffer raises a
+        # DonationError naming this stage program (the bug class the
+        # jit-fallback-after-consumed fix below closed by hand).
+        self._donate = tuple(donate)
 
     def __call__(self, *args):
         with _oom.guard(self.name):
@@ -364,9 +371,16 @@ class _AotProgram:
                     # semantic baseline
             if self._compiled:
                 try:
-                    return self._compiled(*args)
+                    return _donation.guard_dispatch(
+                        self.name, self._compiled, args, self._donate)
                 except Exception as e:  # noqa: BLE001 — see below
                     if _oom.is_resource_exhausted(e):
+                        raise
+                    if isinstance(e, _donation.DonationError):
+                        # The sanitizer caught a stale donated input
+                        # BEFORE dispatch; the jit fallback would read
+                        # the same dead buffers and mask the named
+                        # diagnostic with XLA's deletion error.
                         raise
                     # A RUNTIME failure after XLA consumed the donated
                     # inputs must surface, not retry: the jit fallback
@@ -631,7 +645,7 @@ class _PipelineStep:
             return _AotProgram(name, jax.jit(
                 sm(fn, mesh=mesh, in_specs=in_specs,
                    out_specs=out_specs, check_vma=False),
-                donate_argnums=donate))
+                donate_argnums=donate), donate=donate)
 
         self._bwd: List[Callable] = [None] * S
         self._bwd_acc: List[Callable] = [None] * S

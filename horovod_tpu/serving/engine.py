@@ -51,7 +51,9 @@ from jax.sharding import PartitionSpec as P
 
 from .. import telemetry as _telemetry
 from .. import trace as _trace
+from ..analysis import donation as _donation
 from ..analysis import lockorder as _lockorder
+from ..analysis import threads as _athreads
 from ..core.topology import MODEL_AXIS
 from ..memory import oom as _oom
 from ..memory import planner as _mem_planner
@@ -445,11 +447,21 @@ class InferenceEngine:
         # hvd-mem: harvest compiled.memory_analysis() per serving
         # executable (prefill buckets + decode) into the planner's
         # per-mesh table, where the backend implements the query.
-        _mem_planner.record_compiled(
-            "serving/" + "/".join(str(k) for k in key), compiled)
-        self._exec[key] = compiled
+        label = "serving/" + "/".join(str(k) for k in key)
+        _mem_planner.record_compiled(label, compiled)
+
+        # hvd-race donation sanitizer: every serving dispatch donates
+        # the page arrays (positions 1, 2); routing the executable
+        # through the registry turns a stale re-dispatch of donated
+        # pages (a forgotten replace_pages) into a DonationError naming
+        # this executable instead of XLA's opaque deletion error.
+        def guarded(*call_args, _raw=compiled, _label=label):
+            return _donation.guard_dispatch(_label, _raw, call_args,
+                                            (1, 2))
+
+        self._exec[key] = guarded
         self._record(key[0], key[1] if len(key) > 1 else None)
-        return compiled
+        return guarded
 
     def _rep(self, x) -> jnp.ndarray:
         """Tiny control array → device, replicated under a mesh."""
@@ -1273,6 +1285,13 @@ class InferenceEngine:
         that just died) free their cache mirrors too — without it the
         fleet's caches diverge and every later decode breaks the
         bitwise contract."""
+        # The class threading contract, machine-checked (hvd-race):
+        # under multiprocess only the serve-loop thread may call
+        # abort_all; a stamped runtime thread of any other role
+        # entering here raises ThreadRoleError.  Unstamped (user/main)
+        # threads pass — single-process callers may treat abort_all
+        # like the rest of the drain family.
+        _athreads.require("serve-loop", "InferenceEngine.abort_all")
         # Broadcast OUTSIDE the lock: a wedged control plane blocks a
         # collective forever (no timeout), and holding _drain_lock
         # across it would deadlock the elastic thread's drain/import

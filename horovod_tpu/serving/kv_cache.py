@@ -57,6 +57,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
+from ..analysis import races as _races
 from ..core.topology import MODEL_AXIS
 from ..memory import ledger as _mem
 
@@ -93,6 +94,7 @@ _M_PREFIX_BYTES = _telemetry.counter(
     "logical bytes of the shared pages)")
 
 
+@_races.race_checked
 class PagedKVCache:
     """The paged store for one :class:`~horovod_tpu.serving.engine.
     InferenceEngine`.  The host-side bookkeeping (page table, lengths,

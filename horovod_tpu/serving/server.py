@@ -32,6 +32,7 @@ import time
 from typing import Optional, Tuple
 
 from .. import telemetry as _telemetry
+from ..analysis import threads as _athreads
 from ..telemetry import exporter as _exporter
 from .engine import InferenceEngine
 from .scheduler import FinishReason
@@ -173,7 +174,8 @@ class LMServer:
         except Exception:  # noqa: BLE001 — serving works without init
             return False
 
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # thread: serve-loop
+        _athreads.set_role("serve-loop")
         degraded = False
         while not self._stop.is_set():
             if not degraded and self._control_plane_lost():

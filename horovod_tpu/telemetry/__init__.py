@@ -48,7 +48,8 @@ _default = MetricsRegistry()
 _FLIGHT_TAIL_PREFIXES = ("collective.", "transport.", "host.",
                         "events.", "input.", "trace.", "chaos.",
                         "serving.", "pipeline.", "overlap.",
-                        "checkpoint.", "handles.", "memory.")
+                        "checkpoint.", "handles.", "memory.",
+                        "analysis.")
 
 # Extra tail providers (keyed, replace-on-reregister): subsystems whose
 # dump-time truth lives OUTSIDE the registry (the hvd-mem ledger) merge
@@ -90,6 +91,31 @@ def _flight_metrics_tail() -> Dict[str, object]:
 
 
 flight.set_metrics_provider(_flight_metrics_tail)
+
+
+def _collect_analysis(reg: MetricsRegistry) -> None:
+    """Pull the hvd-analyze runtime checkers' counts (docs/metrics.md
+    "Analysis checkers").  Pull-side by design: the checkers run under
+    arbitrary runtime locks — races._check fires INSIDE registry
+    methods holding ``MetricsRegistry._lock`` — so they keep plain ints
+    and this collector (which runs at snapshot time, outside the
+    registry lock) publishes them as monotonic gauges."""
+    from ..analysis import donation as _donation
+    from ..analysis import races as _races
+    from ..analysis import threads as _threads
+
+    reg.gauge("analysis.race_checks",
+              "lockset verifications by the data-race detector").set(
+        _races.check_count())
+    reg.gauge("analysis.thread_role_asserts",
+              "dynamic thread-role contract verifications").set(
+        _threads.assert_count())
+    reg.gauge("analysis.donation_poisoned",
+              "buffers registered as donated by guard_dispatch").set(
+        _donation.poison_count())
+
+
+_default.register_collector("analysis", _collect_analysis)
 
 
 def registry() -> MetricsRegistry:

@@ -40,7 +40,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
 from ..analysis import lockorder as _lockorder
+from ..analysis import threads as _athreads
 from . import flight as _flight
+from ..analysis import races as _races
 from .registry import MetricsRegistry
 
 _PROM_HELP_TYPES = {"counter": "counter", "gauge": "gauge",
@@ -116,6 +118,7 @@ def prometheus_text(snapshot: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+@_races.race_checked
 class RouteRegistry:
     """Path → handler table shared by every exporter instance.
 
@@ -288,8 +291,12 @@ class MetricsExporter:
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
+        def _serve() -> None:  # thread: exporter
+            _athreads.set_role("exporter")
+            self._server.serve_forever()
+
         self._thread = threading.Thread(
-            target=self._server.serve_forever,
+            target=_serve,
             name="hvd-metrics-exporter", daemon=True)
         self._thread.start()
 

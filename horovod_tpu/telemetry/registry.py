@@ -44,6 +44,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..analysis import lockorder as _lockorder
+from ..analysis import races as _races
 
 
 def metrics_enabled() -> bool:
@@ -224,6 +225,7 @@ class Histogram(_Striped):
         }
 
 
+@_races.race_checked
 class MetricsRegistry:
     """Name-keyed metric table + snapshot-time collectors.
 

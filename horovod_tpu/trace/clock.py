@@ -36,6 +36,7 @@ from typing import Dict, Optional
 
 from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
+from ..analysis import races as _races
 
 # Samples retained per peer.  Small: the minimum over ~32 probes is
 # already within a few microseconds on a healthy fabric, and a bounded
@@ -79,6 +80,7 @@ class OffsetEstimator:
         self._samples.clear()
 
 
+@_races.race_checked
 class ClockSync:
     """Controller-side per-peer estimator set.
 

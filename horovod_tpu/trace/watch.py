@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 
 from .. import telemetry as _telemetry
 from ..analysis import lockorder as _lockorder
+from ..analysis import races as _races
 from ..telemetry import flight as _flight
 
 _M_WARNINGS = _telemetry.counter(
@@ -31,6 +32,7 @@ _M_WARNINGS = _telemetry.counter(
 HISTORY = 256
 
 
+@_races.race_checked
 class SkewTracker:
     """Per-cycle request-arrival skew, fed by
     ``trace.note_batch_arrival``: workers' frames stamp on receipt,

@@ -49,6 +49,7 @@ from .. import chaos as _chaos
 from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..analysis import lockorder as _lockorder
+from ..analysis import threads as _athreads
 from ..core import state as _state
 from ..memory import ledger as _mem
 from ..parallel.data import broadcast_parameters
@@ -221,7 +222,8 @@ class _Writer:
         with self._lock:
             return self._pending
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # thread: writer
+        _athreads.set_role("writer")
         while True:
             item = self._q.get()
             if item is None:  # drain sentinel (wait_all)

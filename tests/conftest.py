@@ -39,6 +39,16 @@ os.environ.setdefault("HVD_TPU_LOCK_CHECK", "1")
 # eager-op creep inside the fused executor fails the suite, not just
 # the dedicated test's scenario.
 os.environ.setdefault("HVD_TPU_COUNT_DISPATCHES", "1")
+# hvd-race: the lockset data-race detector + thread-role asserts
+# (analysis/races.py, analysis/threads.py — the env also gates the
+# race_checked descriptors, so it must be set before horovod_tpu
+# defines its classes) and the donation-lifetime sanitizer
+# (analysis/donation.py) armed suite-wide, like the lock-order
+# detector above: a guarded-field access no single lock protects, a
+# cross-role method entry, or a stale read of a donated buffer raises
+# its named error in whichever test first exhibits it.
+os.environ.setdefault("HVD_TPU_RACE_CHECK", "1")
+os.environ.setdefault("HVD_TPU_DONATION_CHECK", "1")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,6 +1,7 @@
 """Tests for the hvd-analyze subsystem (horovod_tpu/analysis/).
 
-Three passes, three test groups:
+One test group per pass, each seeding a violation and asserting the
+exact named diagnostic:
 
 * lint — each rule catches a seeded violation, the waiver comment works,
   and (the acceptance gate) the shipped tree itself is clean;
@@ -10,7 +11,19 @@ Three passes, three test groups:
   verify_program round-trips single-process;
 * lockorder — a seeded A→B / B→A inversion raises, consistent orders
   and RLock reentrancy do not, and the factories honor
-  HVD_TPU_LOCK_CHECK.
+  HVD_TPU_LOCK_CHECK;
+* races — a two-thread unguarded write on a ``# guarded_by:`` field
+  raises DataRaceError naming field, lock, and both threads; the same
+  interleaving under the annotated lock is silent;
+* threads — a cross-role call is a static thread-role finding (cleared
+  by a handoff marker) and a stamped thread entering another role's
+  method raises ThreadRoleError;
+* donation — a post-donation read is a static finding (cleared by the
+  rebind idiom), and re-dispatching a donated buffer raises
+  DonationError naming the ORIGINAL executable, argument, and site;
+* analyze_sources — the cross-pass driver also audits waivers: a
+  ``# lint: ok(...)`` suppressing nothing is itself a stale-waiver
+  finding, and the shipped tree is clean under ALL passes.
 """
 
 import os
@@ -20,9 +33,13 @@ import textwrap
 import numpy as np
 import pytest
 
+import horovod_tpu.analysis as hvd_analysis
+from horovod_tpu.analysis import donation
 from horovod_tpu.analysis import lint as L
 from horovod_tpu.analysis import lockorder
 from horovod_tpu.analysis import program as prog
+from horovod_tpu.analysis import races
+from horovod_tpu.analysis import threads as troles
 from horovod_tpu.ops import wire
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -500,6 +517,374 @@ def test_trylock_failure_does_not_corrupt_stack():
     # The failed try-acquire released its bookkeeping: reacquire works.
     with a:
         pass
+
+
+# ---------------------------------------------------------------------------
+# races: runtime lockset detector (HVD_TPU_RACE_CHECK=1)
+# ---------------------------------------------------------------------------
+
+def test_data_race_unguarded_cross_thread_write_raises(monkeypatch):
+    """Seeded violation: a second thread writes a ``# guarded_by:``
+    field with no lock held.  The named diagnostic carries the
+    class.field, the annotated lock, and both threads."""
+    monkeypatch.setenv("HVD_TPU_RACE_CHECK", "1")
+
+    @races.race_checked
+    class RaceBox:
+        def __init__(self):
+            self._lock = lockorder.CheckedLock("race.test.RaceBox._lock")
+            self.val = 0  # guarded_by: _lock
+
+    box = RaceBox()   # first-touch thread: the test's main thread
+    box.val = 1       # still exclusive to the owner — silent
+    errs = []
+
+    def bump():
+        try:
+            box.val = 2   # no lock held: write-shares the field
+        except races.DataRaceError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=bump, name="race-bumper")
+    t.start()
+    t.join()
+    assert errs, "unguarded cross-thread write must raise DataRaceError"
+    msg = str(errs[0])
+    assert "data race on RaceBox.val" in msg
+    assert "'_lock'" in msg
+    assert "'race-bumper'" in msg
+    assert "no lock in common" in msg
+    # The field is quarantined after the report, not stuck mid-machine.
+    assert races.states_of(box)["val"] == 3  # _REPORTED
+
+
+def test_locked_cross_thread_access_is_clean(monkeypatch):
+    """The same interleaving under the annotated lock is silent and
+    lands in shared-modified with a live candidate lockset."""
+    monkeypatch.setenv("HVD_TPU_RACE_CHECK", "1")
+
+    @races.race_checked
+    class CleanBox:
+        def __init__(self):
+            self._lock = lockorder.CheckedLock("race.test.CleanBox._lock")
+            self.val = 0  # guarded_by: _lock
+
+    box = CleanBox()
+
+    def bump():
+        with box._lock:
+            box.val += 1
+
+    t = threading.Thread(target=bump, name="clean-bumper")
+    t.start()
+    t.join()
+    with box._lock:
+        box.val += 1
+        assert box.val == 2
+    assert races.states_of(box)["val"] == 2  # _SHARED_MOD, no race
+
+
+def test_read_sharing_needs_no_lock(monkeypatch):
+    """Concurrent READS never race: the field parks in the read-shared
+    state even with an empty lockset (Eraser's read-share rule)."""
+    monkeypatch.setenv("HVD_TPU_RACE_CHECK", "1")
+
+    @races.race_checked
+    class ReadBox:
+        def __init__(self):
+            self._lock = lockorder.CheckedLock("race.test.ReadBox._lock")
+            self.val = 41  # guarded_by: _lock
+
+    box = ReadBox()
+    seen = []
+
+    def peek():
+        seen.append(box.val)
+
+    t = threading.Thread(target=peek, name="reader")
+    t.start()
+    t.join()
+    assert seen == [41]
+    assert races.states_of(box)["val"] == 1  # _SHARED
+
+
+def test_race_checked_is_noop_when_disarmed(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_RACE_CHECK", "0")
+
+    @races.race_checked
+    class PlainBox:
+        def __init__(self):
+            self.val = 0  # guarded_by: _lock
+
+    assert not isinstance(PlainBox.__dict__.get("val"),
+                          races._TrackedField)
+    box = PlainBox()
+    box.val = 7  # no descriptors, no tracking
+    assert races.states_of(box) == {}
+
+
+# ---------------------------------------------------------------------------
+# threads: role contracts — static pass + dynamic asserts
+# ---------------------------------------------------------------------------
+
+THREADED_SRC = """
+    class Pump:
+        def rx_loop(self):  # thread: rx
+            self.flush()
+
+        def flush(self):  # thread: writer
+            pass
+"""
+
+
+def test_thread_role_cross_role_call_is_caught():
+    findings = troles.check_sources(
+        {"seed.py": textwrap.dedent(THREADED_SRC)})
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "thread-role"
+    assert "rx_loop()" in f.message and "flush()" in f.message
+    assert "'rx'" in f.message
+    assert "# thread: writer" in f.message
+    assert "handoff" in f.message
+
+
+def test_thread_role_handoff_marker_clears_the_finding():
+    src = textwrap.dedent("""
+        class Pump:
+            def rx_loop(self):  # thread: rx
+                self.q.put(self.flush)  # thread: handoff(writer queue)
+                self.flush()  # lint: ok(draining inline at shutdown)
+
+            def flush(self):  # thread: writer
+                pass
+    """)
+    assert troles.check_sources({"seed.py": src}) == []
+
+
+def test_thread_role_same_role_call_is_fine():
+    src = textwrap.dedent("""
+        class Pump:
+            def rx_loop(self):  # thread: rx
+                self.on_frame()
+
+            def on_frame(self):  # thread: rx
+                pass
+    """)
+    assert troles.check_sources({"seed.py": src}) == []
+
+
+def test_thread_role_require_raises_across_roles(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_RACE_CHECK", "1")
+    errs = []
+
+    def run():
+        troles.set_role("rx")
+        try:
+            troles.require("serve-loop", "Engine.abort_all")
+        except troles.ThreadRoleError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=run, name="rx-thread")
+    t.start()
+    t.join()
+    assert errs, "a stamped thread entering another role must raise"
+    msg = str(errs[0])
+    assert "Engine.abort_all" in msg
+    assert "# thread: serve-loop" in msg
+    assert "'rx'" in msg and "'rx-thread'" in msg
+
+
+def test_thread_role_unstamped_and_matching_pass(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_RACE_CHECK", "1")
+    # The test's main thread is unstamped: user threads drive any API.
+    troles.require("serve-loop", "Engine.abort_all")
+    ok = []
+
+    def run():
+        troles.set_role("serve-loop")
+        troles.require("serve-loop", "Engine.abort_all")
+        ok.append(True)
+
+    t = threading.Thread(target=run, name="serve-loop-thread")
+    t.start()
+    t.join()
+    assert ok == [True]
+
+
+# ---------------------------------------------------------------------------
+# donation: static post-donation-read rule + runtime sanitizer
+# ---------------------------------------------------------------------------
+
+DONATING_FN = """
+    import jax
+
+    def train(update, params, batch):
+        step = jax.jit(update, donate_argnums=(0,))
+        new_params = step(params, batch)
+        return params, new_params
+"""
+
+
+def test_post_donation_read_is_caught():
+    findings = donation.check_sources(
+        {"seed.py": textwrap.dedent(DONATING_FN)})
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "post-donation-read"
+    assert "'params'" in f.message
+    assert "step()" in f.message
+    assert "position 0" in f.message
+    assert "return value" in f.message
+
+
+def test_post_donation_rebind_idiom_is_clean():
+    src = textwrap.dedent("""
+        import jax
+
+        def train(update, params, batch):
+            step = jax.jit(update, donate_argnums=(0,))
+            params = step(params, batch)
+            return params
+    """)
+    assert donation.check_sources({"seed.py": src}) == []
+
+
+def test_post_donation_read_waiver():
+    src = textwrap.dedent("""
+        import jax
+
+        def train(update, params, batch):
+            step = jax.jit(update, donate_argnums=(0,))
+            out = step(params, batch)
+            return params  # lint: ok(cpu-backend test keeps the ref)
+    """)
+    assert donation.check_sources({"seed.py": src}) == []
+
+
+def test_guard_dispatch_names_the_original_donation(monkeypatch):
+    """Runtime seeded violation: dispatching the same buffer through a
+    donating executable twice raises DonationError naming the FIRST
+    donation's executable, argument index, and site."""
+    monkeypatch.setenv("HVD_TPU_DONATION_CHECK", "1")
+    donation.reset()
+    try:
+        buf = np.ones((4,), np.float32)
+        keep = np.zeros((4,), np.float32)
+        out = donation.guard_dispatch(
+            "serving/decode/b2", lambda a, b: a + b, (buf, keep), (0,))
+        np.testing.assert_allclose(out, 1.0)
+        with pytest.raises(donation.DonationError) as ei:
+            donation.guard_dispatch(
+                "serving/decode/b2", lambda a, b: a + b, (buf, keep),
+                (0,))
+        msg = str(ei.value)
+        assert "use-after-donation" in msg
+        assert "'serving/decode/b2'" in msg
+        assert "argument 0" in msg
+        assert "donated at [" in msg
+        assert "RETURN value" in msg
+    finally:
+        donation.reset()
+
+
+def test_donation_check_probe_and_poisoned_buffer(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DONATION_CHECK", "1")
+    donation.reset()
+    try:
+        buf = np.arange(3.0)
+        donation.register(buf, "mk/group0", 2)
+        with pytest.raises(donation.DonationError) as ei:
+            donation.check(buf)
+        assert "'mk/group0'" in str(ei.value)
+        assert "argument 2" in str(ei.value)
+
+        poisoned = donation.PoisonedBuffer(
+            "pipeline/stage1/jit_b", 0, "pipeline.py:100(dispatch)")
+        with pytest.raises(donation.DonationError) as ei2:
+            _ = poisoned.shape
+        msg = str(ei2.value)
+        assert "'pipeline/stage1/jit_b'" in msg
+        assert "attribute read ('shape')" in msg
+        assert "pipeline.py:100" in msg
+    finally:
+        donation.reset()
+
+
+def test_guard_dispatch_disarmed_is_plain_call(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DONATION_CHECK", "0")
+    donation.reset()
+    buf = np.ones((2,))
+    donation.guard_dispatch("x", lambda a: a * 2, (buf,), (0,))
+    out = donation.guard_dispatch("x", lambda a: a * 2, (buf,), (0,))
+    np.testing.assert_allclose(out, 2.0)  # no registry, no raise
+
+
+# ---------------------------------------------------------------------------
+# analyze_sources: cross-pass driver + stale-waiver audit
+# ---------------------------------------------------------------------------
+
+def test_stale_waiver_is_a_finding():
+    findings = hvd_analysis.analyze_sources({"seed.py": textwrap.dedent("""
+        def f():
+            return 1  # lint: ok(left over from a deleted rule)
+    """)})
+    assert [f.rule for f in findings] == ["stale-waiver"]
+    assert "left over from a deleted rule" in findings[0].message
+    assert "suppresses nothing" in findings[0].message
+
+
+def test_used_waiver_is_not_stale():
+    findings = hvd_analysis.analyze_sources({"seed.py": textwrap.dedent(
+        GUARDED_CLASS + """
+        def waived(self):
+            return len(self.items)  # lint: ok(snapshot for debug dump)
+""")})
+    assert findings == []
+
+
+def test_analyze_sources_merges_every_pass():
+    """One source seeding a lint breach, a cross-role call, a
+    post-donation read, and a stale waiver: the driver reports all
+    four rules, sorted."""
+    src = textwrap.dedent("""
+        import threading
+        import jax
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded_by: _lock
+
+            def bad(self):
+                return len(self.items)
+
+            def rx_loop(self):  # thread: rx
+                self.flush()
+
+            def flush(self):  # thread: writer
+                pass
+
+        def train(update, params, batch):
+            step = jax.jit(update, donate_argnums=(0,))
+            out = step(params, batch)
+            return params
+
+        def clean():
+            return 2  # lint: ok(nothing fires here)
+    """)
+    findings = hvd_analysis.analyze_sources({"seed.py": src})
+    assert sorted(f.rule for f in findings) == [
+        "guarded-by", "post-donation-read", "stale-waiver", "thread-role"]
+
+
+def test_all_passes_shipped_tree_clean():
+    """The PR's acceptance gate: lint + thread-role +
+    post-donation-read + stale-waiver over the shipped package — zero
+    findings (what CI's `python -m horovod_tpu.analysis --strict`
+    enforces)."""
+    findings = hvd_analysis.analyze_paths([PKG])
+    assert findings == [], "\n".join(f.render() for f in findings)
 
 
 # ---------------------------------------------------------------------------

@@ -462,8 +462,29 @@ def test_prefetch_accounts_staged_batches(hvd):
         # whatever is still staged is charged; the consumed one is not
         assert led.peak_by_category().get("input.prefetch", 0) >= \
             batches[0].nbytes
-    # close() released everything still queued
+    # close() released everything still queued — including the final
+    # batch a stager parked in put() can land AFTER the first drain
+    # (the post-join drain in PrefetchIterator.close owns that window).
     assert led.bytes_by_category().get("input.prefetch", 0) == 0
+    led.reset()
+
+
+def test_prefetch_mid_epoch_close_never_leaks_charges(hvd):
+    """Regression for the close()-vs-stager race: shutting down with
+    the stager mid-stream must drain every charged batch, repeatedly —
+    the leaked "input.prefetch" charge was a once-per-hundreds flake,
+    so hammer the window."""
+    from horovod_tpu.parallel.input import prefetch_to_device
+
+    led = ledger_mod.ledger
+    led.reset()
+    for trial in range(20):
+        batches = (np.full((16, 16), i, np.float32) for i in range(64))
+        with prefetch_to_device(batches, depth=2) as it:
+            next(it)  # stager now racing to refill the bounded queue
+        leaked = led.bytes_by_category().get("input.prefetch", 0)
+        assert leaked == 0, (
+            f"trial {trial}: {leaked} bytes still charged after close()")
     led.reset()
 
 
