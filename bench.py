@@ -507,8 +507,14 @@ def _dataplane_bench(tensors: int = 32, elems: int = 256,
         inputs = [hvd.shard(t) for t in base]
 
         def cycle(tag):
-            hs = [hvd.allreduce_async(x, average=True, name=f"{tag}.{j}")
-                  for j, x in enumerate(inputs)]
+            # quiesce: the background drain tick must not fire between
+            # two submissions of one cycle — it would negotiate them as
+            # two fused responses and break every ==1-launch contract
+            # below.  One explicit drain on exit serves the whole group.
+            with hvd.quiesce():
+                hs = [hvd.allreduce_async(x, average=True,
+                                          name=f"{tag}.{j}")
+                      for j, x in enumerate(inputs)]
             return [hvd.synchronize(h) for h in hs]
 
         def measure(tag, mega):
@@ -575,35 +581,18 @@ def _dataplane_bench(tensors: int = 32, elems: int = 256,
             if comp_name != "none":
                 # Fresh names → tick 0, zero residuals: the reference
                 # must match the fused kernel BITWISE.  The reference
-                # models single-group packing, and a concurrent drain
-                # tick can legally split a cycle across two fused
-                # responses — retry under fresh names until the cycle
-                # landed in exactly one launch (same policy as
-                # tests/test_megakernel.py).
-                for attempt in range(8):
-                    launches0 = mk.stats.launches
-                    got = cycle(f"refq.{comp_name}.{attempt}")
-                    if mk.stats.launches - launches0 == 1:
-                        fmt = _compression.wire_format(comp_name)
-                        ref, _ = _compression.reference_allreduce(
-                            rows, fmt, 0)
-                        expected = np.asarray(
-                            jnp.asarray(ref) / n)  # AVERAGE
-                        got_flat = np.concatenate(
-                            [np.asarray(r)[0].reshape(-1) for r in got])
-                        ref_equal = bool(
-                            expected.tobytes() == got_flat.tobytes())
-                        break
-            # Same split-race policy as the reference loop above: a
-            # drain tick under load can legally partition the counted
-            # cycle into two fused responses — retry until the count
-            # observed a single-launch steady-state cycle, so the
-            # ==1-dispatch contract gates the pipeline, not box load.
-            for attempt in range(8):
-                _, disp_c, lat_c, grp = measure(
-                    f"comp.{comp_name}.{attempt}", True)
-                if grp == 1:
-                    break
+                # models single-group packing; cycle() quiesces the
+                # drain tick, so the cycle lands in exactly one launch
+                # deterministically — no retry loop needed.
+                got = cycle(f"refq.{comp_name}")
+                fmt = _compression.wire_format(comp_name)
+                ref, _ = _compression.reference_allreduce(rows, fmt, 0)
+                expected = np.asarray(jnp.asarray(ref) / n)  # AVERAGE
+                got_flat = np.concatenate(
+                    [np.asarray(r)[0].reshape(-1) for r in got])
+                ref_equal = bool(
+                    expected.tobytes() == got_flat.tobytes())
+            _, disp_c, lat_c, grp = measure(f"comp.{comp_name}", True)
             if comp_name == "none":
                 # The ADJACENT uncompressed measurement is the
                 # throughput baseline — comparing against a leg timed
@@ -631,18 +620,15 @@ def _dataplane_bench(tensors: int = 32, elems: int = 256,
         # hvd-mem: measured ledger high-watermark of one steady-state
         # fused cycle vs the static planner's prediction (the ±15 %
         # accuracy contract of docs/memory.md; --mode memory owns the
-        # CI gate, this section records the figures per round).  Same
-        # split-race retry policy as the dispatch-count contract.
+        # CI gate, this section records the figures per round).
+        # cycle() quiesces the drain tick, so the watermark always
+        # observes a single-launch cycle.
         from horovod_tpu.memory import ledger as _mem_ledger
         from horovod_tpu.memory import planner as _mem_planner
 
         led = _mem_ledger.ledger
-        for attempt in range(8):
-            led.reset()
-            launches0 = mk.stats.launches
-            cycle(f"memsec.{attempt}")
-            if mk.stats.launches - launches0 == 1:
-                break
+        led.reset()
+        cycle("memsec")
         mem_measured = led.watermark()
         mem_predicted = _mem_planner.plan_dataplane(
             tensors, elems, n).framework_bytes
@@ -1455,20 +1441,17 @@ def _memory_bench(tensors: int = 16, elems: int = 256,
         inputs = [hvd.shard(t) for t in base]
 
         def cycle(tag):
-            hs = [hvd.allreduce_async(x, average=True,
-                                      name=f"{tag}.{j}")
-                  for j, x in enumerate(inputs)]
+            # quiesce: submissions land as ONE fused response (the
+            # prediction below models the single fused launch).
+            with hvd.quiesce():
+                hs = [hvd.allreduce_async(x, average=True,
+                                          name=f"{tag}.{j}")
+                      for j, x in enumerate(inputs)]
             return [hvd.synchronize(h) for h in hs]
 
         cycle("warm")
-        # Dataplane accuracy (same split-race retry as the dispatch
-        # contract: the prediction models the single fused launch).
-        for attempt in range(8):
-            led.reset()
-            launches0 = mk.stats.launches
-            cycle(f"acc.{attempt}")
-            if mk.stats.launches - launches0 == 1:
-                break
+        led.reset()
+        cycle("acc")
         dp_measured = led.watermark()
         dp_predicted = _mem_planner.plan_dataplane(
             tensors, elems, n).framework_bytes
@@ -1605,6 +1588,193 @@ def _memory_bench(tensors: int = 16, elems: int = 256,
         }
     finally:
         hvd.shutdown()
+
+
+def _fused_bench(rows: int = 1024, k: int = 512, n_feat: int = 512,
+                 cycles: int = 7) -> dict:
+    """hvd-fuse microbench (``--mode fused``): the fused
+    computation-collective contracts, CPU-only like ``--mode control``
+    (8-virtual-device mesh, no TPU tunnel — XLA:CPU's thunk runtime
+    genuinely overlaps a chunk's psum with the next chunk's GEMM, so
+    the exposed-communication contract measures for real here).
+
+    Four gates ride the JSON (CI job ``fused-bench``, ``--check-speedup``):
+
+    * ``bitwise.*`` — every fused program (tensor-parallel psum closer,
+      MoE dispatch→FFN→combine round trip) reproduces its unfused
+      reference program's bytes exactly;
+    * ``dispatches_per_fused_group`` — one fused group is ONE XLA
+      executable launch, counted at jax's dispatch choke point
+      (utils/xla_dispatch.py), on both legs;
+    * ``exposed_comm.strictly_below`` — the fused leg's exposed
+      communication seconds (``max(0, total - compute_only)``, the
+      ``fused.exposed_comm_seconds`` figure) land strictly below the
+      unfused leg's — i.e. chunking actually hid the collective;
+    * ``bitwise.fallback_off_parity`` — ``HVD_TPU_FUSE=off`` pins the
+      unfused reference program bytes.
+    """
+    os.environ["HVD_TPU_COUNT_DISPATCHES"] = "1"
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.core import compat as _compat
+    from horovod_tpu.core.topology import (EXPERT_AXIS, MODEL_AXIS,
+                                           make_mesh)
+    from horovod_tpu.memory import planner as _mem_planner
+    from horovod_tpu.ops import fused as F
+    from horovod_tpu.parallel.expert import (MoEOutput, init_moe_params,
+                                             local_experts, moe_layer)
+    from horovod_tpu.utils import xla_dispatch
+
+    n = 8
+    mesh = make_mesh(model=n)
+    chunks = F.fuse_chunks()
+    rng = np.random.default_rng(17)
+    x = jnp.asarray(rng.standard_normal((rows, k)).astype(np.float32))
+    w = jnp.asarray((rng.standard_normal((k, n_feat)) * 0.05)
+                    .astype(np.float32))
+
+    def build_tensor(fuse, with_comm=True):
+        # The row-parallel closer body (parallel/tensor.row_parallel's
+        # exact dot→psum ordering); with_comm=False elides the
+        # collective legs — the compute_only baseline both exposed
+        # measurements subtract.
+        def body(x, w):
+            def leg(xc):
+                part = jnp.dot(xc, w,
+                               preferred_element_type=jnp.float32)
+                if with_comm:
+                    part = jax.lax.psum(part, MODEL_AXIS)
+                return part
+            return F.chunked_map(leg, x, axis=0, chunks=chunks,
+                                 fuse=fuse)
+        return jax.jit(_compat.shard_map(
+            body, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))
+
+    fused_t = build_tensor(True)
+    unfused_t = build_tensor(False)
+    tensor_bitwise = bool(
+        np.asarray(fused_t(x, w)).tobytes()
+        == np.asarray(unfused_t(x, w)).tobytes())
+
+    # Fallback parity: HVD_TPU_FUSE=off must pin the reference program
+    # even when the call site passes no explicit override.
+    prev = os.environ.get(F.FUSE_ENV)
+    os.environ[F.FUSE_ENV] = "off"
+    try:
+        off_t = build_tensor(None)
+        fallback_parity = bool(
+            np.asarray(off_t(x, w)).tobytes()
+            == np.asarray(unfused_t(x, w)).tobytes())
+    finally:
+        if prev is None:
+            os.environ.pop(F.FUSE_ENV, None)
+        else:
+            os.environ[F.FUSE_ENV] = prev
+
+    # One fused group == ONE XLA executable launch (warm).
+    def count_dispatches(fn, *args):
+        jax.block_until_ready(fn(*args))
+        with xla_dispatch.exact_scope():
+            with xla_dispatch.record(all_threads=True) as scope:
+                jax.block_until_ready(fn(*args))
+        return scope.count
+
+    tensor_disp = count_dispatches(fused_t, x, w)
+
+    # Exposed communication: both legs against their own compute_only
+    # baseline, same clamp + median idiom (ops/fused.measure_exposed_
+    # comm) — the unfused leg serializes GEMM→psum, the fused leg hides
+    # chunk i's psum under chunk i+1's GEMM.
+    exposed_unfused = F.measure_exposed_comm(
+        unfused_t, build_tensor(False, with_comm=False), (x, w),
+        cycles=cycles)
+    exposed_fused = F.measure_exposed_comm(
+        fused_t, build_tensor(True, with_comm=False), (x, w),
+        cycles=cycles)
+    strictly_below = bool(exposed_fused < exposed_unfused)
+
+    # The flagship: the MoE dispatch→FFN→combine round trip, fused vs
+    # unfused, bitwise, on its own expert mesh.
+    E, D, H, tokens = 8, 16, 32, 256
+    mesh_e = make_mesh(expert=n)
+    key = jax.random.PRNGKey(5)
+    kx, kp = jax.random.split(key)
+    from jax.sharding import NamedSharding
+    # Pre-place on the expert mesh: an uncommitted input would cost an
+    # implicit reshard executable and double the counted dispatches.
+    xe = jax.device_put(jax.random.normal(kx, (tokens, D)),
+                        NamedSharding(mesh_e, P(EXPERT_AXIS)))
+    params = jax.device_put(init_moe_params(kp, E, D, H),
+                            NamedSharding(mesh_e, P()))
+
+    def build_moe(fuse):
+        def f(x, params):
+            mine = local_experts(params, axis_name=EXPERT_AXIS)
+            return moe_layer(x, mine, axis_name=EXPERT_AXIS,
+                             num_experts=E, top_k=2,
+                             capacity_factor=8.0, fuse=fuse,
+                             fuse_chunks=chunks)
+        return jax.jit(_compat.shard_map(
+            f, mesh=mesh_e, in_specs=(P(EXPERT_AXIS), P()),
+            out_specs=MoEOutput(P(EXPERT_AXIS), P(), P()),
+            check_vma=False))
+
+    moe_f = build_moe(True)
+    moe_u = build_moe(False)
+    got_f, got_u = moe_f(xe, params), moe_u(xe, params)
+    moe_bitwise = all(
+        np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        for a, b in zip(got_f, got_u))
+    moe_disp = count_dispatches(moe_f, xe, params)
+
+    # Host-side services: dispatch the tensor group through
+    # FusedProgram so the bench exercises the AOT-compile → manifest →
+    # ledger-charge path and the run's JSON carries the counters.
+    launch_bytes = _mem_planner.fused_group_bytes(
+        (rows, n_feat), chunks, dtype="float32")
+    prog = F.FusedProgram("bench/row_parallel", fused_t, mesh=mesh,
+                          chunks=chunks, launch_bytes=launch_bytes)
+    jax.block_until_ready(prog(x, w))
+    wrapped_bitwise = bool(
+        np.asarray(prog(x, w)).tobytes()
+        == np.asarray(unfused_t(x, w)).tobytes())
+
+    hidden_pct = (round((1.0 - exposed_fused / exposed_unfused) * 100.0,
+                        1) if exposed_unfused else None)
+    return {
+        "metric": "fused_exposed_comm_us",
+        "value": round(exposed_fused * 1e6, 1),
+        "unit": "us/group",
+        "vs_baseline": round(exposed_unfused * 1e6, 1),
+        "exposed_comm": {
+            "unfused_us": round(exposed_unfused * 1e6, 1),
+            "fused_us": round(exposed_fused * 1e6, 1),
+            "hidden_pct": hidden_pct,
+            "strictly_below": strictly_below,
+        },
+        "bitwise": {
+            "tensor_psum": tensor_bitwise,
+            "expert_roundtrip": bool(moe_bitwise),
+            "fused_program_wrapper": wrapped_bitwise,
+            "fallback_off_parity": fallback_parity,
+        },
+        "dispatches_per_fused_group": {
+            "tensor": tensor_disp,
+            "expert": moe_disp,
+        },
+        "chunks": chunks,
+        "rows": rows,
+        "launch_bytes": launch_bytes,
+        "telemetry": {
+            "groups_compiled": F._M_GROUPS.value,
+            "launches": F._M_LAUNCHES.value,
+        },
+        "replicas": n,
+    }
 
 
 def _serving_bench(n_requests: int = 40, max_slots: int = 8,
@@ -2014,7 +2184,7 @@ def main() -> int:
     ap.add_argument("--mode",
                     choices=["resnet", "control", "dataplane", "input",
                              "serving", "overlap", "pipeline",
-                             "memory"],
+                             "memory", "fused"],
                     default="resnet",
                     help="control = control-plane negotiations/sec only "
                          "(no XLA, no TPU tunnel); dataplane = "
@@ -2039,7 +2209,11 @@ def main() -> int:
                          "(no TPU tunnel); memory = hvd-mem planner "
                          "accuracy vs the live ledger, plan "
                          "determinism, and the seeded-OOM forensics "
-                         "path (no TPU tunnel)")
+                         "path (no TPU tunnel); fused = hvd-fuse "
+                         "computation-collective kernels — bitwise vs "
+                         "the unfused reference, one-dispatch-per-"
+                         "group, and exposed-communication strictly "
+                         "below the unfused leg (no TPU tunnel)")
     ap.add_argument("--check-speedup", type=float, default=None,
                     help="control mode: exit nonzero when the cache-on/"
                          "cache-off speedup is below this bound; "
@@ -2255,6 +2429,44 @@ def main() -> int:
                 failures.append(
                     f"seeded RESOURCE_EXHAUSTED did not produce the "
                     f"forensic dump: {result.get('oom_dump')}")
+            if failures:
+                for f in failures:
+                    print(f"FAIL: {f}", file=sys.stderr)
+                return 1
+        return 0
+
+    if args.mode == "fused":
+        # CPU-only like --mode dataplane: pin the 8-virtual-device mesh
+        # before the first jax import (same bootstrap as conftest.py).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        result = _fused_bench()
+        print(json.dumps(result))
+        if args.check_speedup is not None:
+            failures = []
+            for name, ok in (result.get("bitwise") or {}).items():
+                if not ok:
+                    failures.append(
+                        f"fused {name} program not bitwise-identical "
+                        f"to the unfused reference")
+            for leg, disp in (result.get("dispatches_per_fused_group")
+                              or {}).items():
+                if disp != 1:
+                    failures.append(
+                        f"{leg} fused group dispatched {disp} XLA "
+                        f"executables per cycle (contract: exactly 1)")
+            if not (result.get("exposed_comm")
+                    or {}).get("strictly_below"):
+                ec = result.get("exposed_comm") or {}
+                failures.append(
+                    f"fused exposed communication "
+                    f"{ec.get('fused_us')}us not strictly below the "
+                    f"unfused leg's {ec.get('unfused_us')}us")
             if failures:
                 for f in failures:
                     print(f"FAIL: {f}", file=sys.stderr)

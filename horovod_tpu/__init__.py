@@ -66,6 +66,7 @@ from .ops.collective import (  # noqa: F401
     global_process_set,
     join,
     poll,
+    quiesce,
     reducescatter,
     reducescatter_async,
     remove_process_set,
@@ -122,5 +123,7 @@ from .trace.merge import dump_fleet_trace  # noqa: F401
 from .trace.watch import StragglerWatch  # noqa: F401
 from . import memory  # noqa: F401  (hvd.memory: ledger/planner/oom)
 from .memory import MemoryWatch  # noqa: F401
+from .ops import fused  # noqa: F401  (hvd.fused: computation-collective
+#                                      kernels — matmul_psum & co)
 
 __version__ = "0.1.0"

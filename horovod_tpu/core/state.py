@@ -182,6 +182,7 @@ def init(devices=None) -> None:
     from .. import chaos as _chaos_env
     from ..memory import oom as _mem_oom
     from ..ops import compression as _compression_env
+    from ..ops import fused as _fused_env
     from ..ops import tree as _tree_env
     from ..parallel import overlap as _overlap_env
     from ..parallel import pipeline as _pipeline_env
@@ -192,6 +193,8 @@ def init(devices=None) -> None:
     _overlap_env.validate_env()
     _pipeline_env.validate_env()
     _tree_env.validate_env()
+    # hvd-fuse: mode/chunk knobs select the compiled SPMD program.
+    _fused_env.validate_env()
     # hvd-mem: a typo'd HVD_TPU_MEM_CAPACITY must fail init too.
     _mem_oom.validate_env()
     # hvd-chaos: a typo'd HVD_TPU_FAULTS clause must abort init with

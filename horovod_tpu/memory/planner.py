@@ -139,6 +139,24 @@ def prefetch_bytes(depth: int, batch_bytes: int) -> int:
     return (depth + 1) * batch_bytes
 
 
+def fused_group_bytes(out_shape: Tuple[int, ...], chunks: int,
+                      dtype="float32", chunk_axis: int = 0) -> int:
+    """Bytes one fused computation-collective launch holds live beyond
+    its inputs: the full output plus ONE chunk's partial product — the
+    interleave buffer a chunk's collective leg reads while the next
+    chunk computes (ops/fused.py).  This is the function
+    :class:`~..ops.fused.FusedProgram` charges the ledger's
+    ``fused.launch`` category with — prediction and measurement share
+    one model by construction."""
+    item = dtype_bytes(dtype)
+    total = int(math.prod(out_shape)) if out_shape else 1
+    rows = out_shape[chunk_axis] if out_shape else 1
+    c = max(1, min(int(chunks), max(1, rows)))
+    chunk_rows = -(-rows // c)  # ceil: the largest chunk in the plan
+    chunk = total // max(1, rows) * chunk_rows
+    return (total + chunk) * item
+
+
 # ---------------------------------------------------------------------------
 # Harvest: compiled.memory_analysis() per AOT executable
 # ---------------------------------------------------------------------------

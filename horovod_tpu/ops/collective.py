@@ -37,6 +37,7 @@ reference: docs/tensor-fusion.md, operations.cc:1328-1374) when drained.
 
 from __future__ import annotations
 
+import contextlib
 import math
 import sys
 import threading
@@ -2254,6 +2255,33 @@ def _drain() -> None:
                 st.autotuner.record_bytes(sum(o.nbytes for o in ops))
         if st.autotuner is not None:
             st.autotuner.maybe_step()
+
+
+@contextlib.contextmanager
+def quiesce():
+    """Hold the drain lock across a group of ``*_async`` submissions so
+    the background 5 ms tick cannot negotiate a partial group, then run
+    one explicit drain on exit.
+
+    This is the sanctioned fix for the submission-split race: without
+    it, a tick that fires between two submissions of one logical cycle
+    negotiates them as two fused responses, which perturbs anything
+    that asserts on fusion granularity (bench dataplane legs, ledger
+    accounting tests).  Same pattern as
+    ``overlap.dispatch_bucket_segment``::
+
+        with C.quiesce():
+            h1 = C.allreduce_async(a, name="cycle.a")
+            h2 = C.allreduce_async(b, name="cycle.b")
+        C.synchronize(h1); C.synchronize(h2)
+
+    The body must only *submit* — calling :func:`synchronize` (or
+    anything that waits on a response) inside the block deadlocks,
+    because progress requires the drain the block is deferring.
+    """
+    with _drain_lock:
+        yield
+    _drain()
 
 
 # ---------------------------------------------------------------------------

@@ -764,6 +764,11 @@ _SPMD_ENV_KNOBS = (
     # conversation itself (who connects to whom, which frames flow), so
     # a divergent rank would deadlock the handshake — name it at init.
     "HVD_TPU_TREE", "HVD_TPU_TREE_FANOUT", "HVD_TPU_TREE_THRESHOLD",
+    # Fused computation-collective kernels (ops/fused.py): mode and
+    # chunk count are part of the compiled SPMD program's identity —
+    # a rank with a different chunk plan compiles a DIFFERENT program
+    # for the same collective, so divergence must be named at startup.
+    "HVD_TPU_FUSE", "HVD_TPU_FUSE_CHUNKS",
 )
 
 

@@ -15,6 +15,21 @@ Everything is dense einsums over static shapes (dispatch/combine one-hot
 tensors), so XLA tiles it onto the MXU and overlaps the two collectives —
 no scalar gather/scatter loops.
 
+**Fused hot path** (hvd-fuse, arXiv:2305.06942; ops/fused.py): the
+dispatch all_to_all → expert FFN GEMMs → combine all_to_all pipeline is
+chunked along the CAPACITY axis — each chunk runs the full round trip,
+so chunk *i*'s all_to_all legs fly while chunk *i+1*'s FFN computes,
+inside ONE XLA program.  Routing (router GEMM, top-k dispatch, aux
+loss) stays whole: it is the producer every chunk depends on.  The
+chunked output is BITWISE-identical to the unfused reference
+(tests/test_fused.py): capacity rows are reduction-free, each chunk's
+einsums keep the unfused contraction order, and the combine all_to_all
+inverts the dispatch all_to_all's tiled row permutation chunk-by-chunk
+so the concatenation restores the exact unfused layout.
+``HVD_TPU_FUSE=off`` (or ``fuse=False``) pins the unfused reference
+program; ``HVD_TPU_FUSE_CHUNKS`` bounds the chunk count (both knobs
+ride the HELLO env fingerprint).
+
 Conventionally EP rides the *data* axis (expert groups = DP groups):
 pass ``axis_name="data"``; a dedicated ``expert`` axis works identically.
 """
@@ -27,6 +42,8 @@ import jax
 
 from ..core import compat as _compat
 import jax.numpy as jnp
+
+from ..ops import fused as _fused
 
 
 class MoEOutput(NamedTuple):
@@ -113,7 +130,9 @@ def _top_k_dispatch(probs, k: int, capacity: int):
 def moe_layer(x, params: dict, *, axis_name: str, num_experts: int,
               top_k: int = 2, capacity_factor: float = 1.25,
               activation=jax.nn.gelu,
-              aux_loss_weight: float = 1e-2) -> MoEOutput:
+              aux_loss_weight: float = 1e-2,
+              fuse: Optional[bool] = None,
+              fuse_chunks: Optional[int] = None) -> MoEOutput:
     """Sharded mixture-of-experts FFN (inside shard_map over
     ``axis_name``).
 
@@ -123,6 +142,11 @@ def moe_layer(x, params: dict, *, axis_name: str, num_experts: int,
         ``w_out [E_local, h, d]`` — expert leading axes already sharded
         (e.g. via :func:`local_experts`).
       num_experts: global expert count E (must divide by the axis size).
+      fuse: override the ``HVD_TPU_FUSE`` knob for this layer —
+        ``False`` pins the unfused reference program (bitwise-identical
+        output either way; see the module docstring).
+      fuse_chunks: override ``HVD_TPU_FUSE_CHUNKS`` — capacity-axis
+        chunks of the fused dispatch→FFN→combine round trip.
     """
     n = _compat.axis_size(axis_name)
     tokens, d_model = x.shape
@@ -153,17 +177,28 @@ def moe_layer(x, params: dict, *, axis_name: str, num_experts: int,
     # experts' buffers from every peer.
     expert_in = jnp.einsum("td,tec->ecd", x.astype(jnp.float32),
                            dispatch.astype(jnp.float32))
-    expert_in = jax.lax.all_to_all(expert_in, axis_name, split_axis=0,
-                                   concat_axis=1, tiled=True)
-    # -> [E_local, n*C, d]: run the local experts on everyone's tokens.
-    h = jnp.einsum("ecd,edh->ech", expert_in,
-                   params["w_in"].astype(jnp.float32))
-    h = activation(h)
-    expert_out = jnp.einsum("ech,ehd->ecd", h,
-                            params["w_out"].astype(jnp.float32))
-    # Return trip and weighted combine.
-    expert_out = jax.lax.all_to_all(expert_out, axis_name, split_axis=1,
-                                    concat_axis=0, tiled=True)
+    w_in = params["w_in"].astype(jnp.float32)
+    w_out = params["w_out"].astype(jnp.float32)
+
+    def roundtrip(buf):
+        # One capacity chunk's full trip: route out, compute, route
+        # back.  [E, c, d] -> [E_local, n*c, d] -> ... -> [E, c, d].
+        buf = jax.lax.all_to_all(buf, axis_name, split_axis=0,
+                                 concat_axis=1, tiled=True)
+        # Run the local experts on everyone's tokens.
+        h = jnp.einsum("ecd,edh->ech", buf, w_in)
+        h = activation(h)
+        o = jnp.einsum("ech,ehd->ecd", h, w_out)
+        # Return trip: the inverse all_to_all undoes the dispatch
+        # leg's tiled row permutation within the chunk.
+        return jax.lax.all_to_all(o, axis_name, split_axis=1,
+                                  concat_axis=0, tiled=True)
+
+    # hvd-fuse: emit the round trip per capacity chunk inside this one
+    # program — chunk i's all_to_all legs overlap chunk i+1's FFN.
+    # One chunk (or fuse=False) IS the unfused reference program.
+    expert_out = _fused.chunked_map(roundtrip, expert_in, axis=1,
+                                    chunks=fuse_chunks, fuse=fuse)
     out = jnp.einsum("ecd,tec->td", expert_out,
                      combine.astype(jnp.float32))
     return MoEOutput(out.astype(x.dtype), aux.astype(jnp.float32),

@@ -66,6 +66,27 @@ microbatch-mean loss) the parity is allclose, not bitwise — XLA
 compiles per-stage programs with different fusion decisions than one
 whole-graph backward (the same ULP story as
 ``parallel/overlap.ChainedLoss``).
+
+**Sub-mesh placement (mp × pipeline; hvd-fuse)**: pass
+``stage_meshes=[mesh_0, ..., mesh_{S-1}]`` (e.g. from
+:func:`stage_submeshes`) and each stage's executables compile over its
+OWN sub-mesh instead of sharing the global replica mesh — real MPMD
+placement: stage *k*'s forward/backward/apply only ever touch stage
+*k*'s devices, and the host loop moves boundary carries/cotangents
+between sub-meshes with ``device_put``.  A sub-mesh may carry extra
+axes beyond :data:`~..core.state.REPLICA_AXIS` (e.g.
+:data:`~..core.topology.MODEL_AXIS`), so a stage body can run
+tensor-parallel fused closers (``parallel/tensor.py``) inside its own
+sub-mesh — the mp × pipeline composition.  Under placement the
+per-stage gradient reduction leaves the dynamic bucket path: each
+stage gets ONE fused reduce+apply program (in-program ``psum`` over
+the stage's replica axis + optimizer update, an
+:class:`~..ops.fused.FusedProgram`) dispatched the moment the stage's
+last backward is in flight (1F1B) or after the flush fence (the GPipe
+comparator) — 1f1b ≡ gpipe stays bitwise under placement because the
+programs and accumulation chains are identical, only dispatch points
+move.  ``opt_state`` must then be a per-stage sequence (mirroring
+``params``), and ``donate`` applies to the backward programs only.
 """
 
 from __future__ import annotations
@@ -76,10 +97,12 @@ import os
 import time
 from dataclasses import dataclass, field
 from types import SimpleNamespace
-from typing import Callable, List, NamedTuple, Optional, Tuple
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from .. import telemetry as _telemetry
@@ -87,10 +110,11 @@ from ..analysis import donation as _donation
 from ..core import compat as _compat
 from ..core import state as _state
 from ..core.state import REPLICA_AXIS
-from ..core.topology import PIPE_AXIS
+from ..core.topology import MODEL_AXIS, PIPE_AXIS
 from ..memory import ledger as _mem
 from ..memory import oom as _oom
 from ..memory import planner as _mem_planner
+from ..ops import fused as _fused
 
 try:
     import optax
@@ -331,6 +355,77 @@ def schedule_plan(n_stages: int, num_microbatches: int,
 
 
 # ---------------------------------------------------------------------------
+# Sub-mesh placement (mp × pipeline)
+# ---------------------------------------------------------------------------
+
+def stage_submeshes(n_stages: int, *, mesh=None, model: int = 1
+                    ) -> Tuple[jax.sharding.Mesh, ...]:
+    """Split a replica mesh's devices into ``n_stages`` contiguous
+    sub-meshes — the standard placement for
+    ``make_pipeline_train_step(..., stage_meshes=...)``.
+
+    Each sub-mesh gets ``devices/n_stages`` devices shaped
+    ``(replica, model)``: axis :data:`~..core.state.REPLICA_AXIS` plus,
+    when ``model > 1``, :data:`~..core.topology.MODEL_AXIS` — so a
+    stage body can run tensor-parallel fused closers on its own
+    devices (the mp × pipeline composition).  Contiguous splits keep
+    each stage inside one ICI neighborhood on real slice topologies.
+    """
+    mesh = mesh or _state.mesh()
+    devs = list(mesh.devices.flat)
+    S, v = int(n_stages), int(model)
+    if S < 1 or v < 1:
+        raise ValueError(f"n_stages={S} and model={v} must be >= 1")
+    if len(devs) % S != 0:
+        raise ValueError(
+            f"{len(devs)} devices do not split into {S} equal stage "
+            f"sub-meshes")
+    per = len(devs) // S
+    if per % v != 0:
+        raise ValueError(
+            f"stage sub-mesh of {per} devices is not divisible by "
+            f"model={v}")
+    out = []
+    for s in range(S):
+        block = np.asarray(devs[s * per:(s + 1) * per])
+        if v == 1:
+            out.append(jax.sharding.Mesh(block, (REPLICA_AXIS,)))
+        else:
+            out.append(jax.sharding.Mesh(
+                block.reshape(per // v, v), (REPLICA_AXIS, MODEL_AXIS)))
+    return tuple(out)
+
+
+def _validate_stage_meshes(stage_meshes, n_stages: int) -> tuple:
+    meshes = tuple(stage_meshes)
+    if len(meshes) != n_stages:
+        raise ValueError(
+            f"stage_meshes has {len(meshes)} meshes for {n_stages} "
+            f"stages — one sub-mesh per stage")
+    sizes = set()
+    for k, mk in enumerate(meshes):
+        if REPLICA_AXIS not in mk.axis_names:
+            raise ValueError(
+                f"stage_meshes[{k}] has axes {mk.axis_names!r}; every "
+                f"stage sub-mesh needs the {REPLICA_AXIS!r} replica "
+                f"axis (extra axes like {MODEL_AXIS!r} are fine)")
+        sizes.add(int(mk.shape[REPLICA_AXIS]))
+    if len(sizes) > 1:
+        raise ValueError(
+            f"stage sub-meshes disagree on replica count "
+            f"({sorted(sizes)}): boundary carries are sharded over the "
+            f"replica axis, so every stage needs the same count")
+    return meshes
+
+
+def _to_mesh(tree, mesh, spec):
+    """Move a pytree onto ``mesh`` with ``spec`` on every leaf — the
+    host-side boundary transfer between stage sub-meshes."""
+    s = NamedSharding(mesh, spec)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, s), tree)
+
+
+# ---------------------------------------------------------------------------
 # The MPMD pipeline train step
 # ---------------------------------------------------------------------------
 
@@ -413,7 +508,8 @@ class _PipelineStep:
 
     def __init__(self, chain, optimizer, mesh, num_microbatches: int,
                  schedule: str, interleave: int, average: bool,
-                 fusion_threshold: Optional[int], donate: bool):
+                 fusion_threshold: Optional[int], donate: bool,
+                 stage_meshes=None):
         from .overlap import ChainedLoss, _next_prefix
 
         if optax is None:  # pragma: no cover - optax baked into image
@@ -427,14 +523,27 @@ class _PipelineStep:
                 "make_train_step")
         self._chain = chain
         self._optimizer = optimizer
-        self._mesh = mesh or _state.mesh()
-        self._m = int(num_microbatches)
         self._S = len(chain.stages)
+        self._stage_meshes = None if stage_meshes is None else \
+            _validate_stage_meshes(stage_meshes, self._S)
+        if self._stage_meshes is not None:
+            # Placed mode never touches the global replica mesh; keep a
+            # reference mesh for sizing (batch divisibility = the
+            # per-stage replica count).
+            self._mesh = self._stage_meshes[0]
+        else:
+            self._mesh = mesh or _state.mesh()
+        self._m = int(num_microbatches)
         self._average = average
         self._fusion_threshold = fusion_threshold
         self._donate = donate
         from .overlap import _is_cpu_mesh
 
+        # Data-parallel width: the replica axis alone (a placed
+        # sub-mesh may carry a model axis on top).
+        self._replicas = int(self._mesh.shape[REPLICA_AXIS]) \
+            if REPLICA_AXIS in self._mesh.axis_names \
+            else int(self._mesh.devices.size)
         self._plan = schedule_plan(self._S, self._m, schedule, interleave)
         self._prefix = _next_prefix()
         self._built = False
@@ -455,9 +564,19 @@ class _PipelineStep:
         return None if self._bucket_plan is None \
             else self._bucket_plan.n_buckets
 
+    @property
+    def stage_meshes(self) -> Optional[tuple]:
+        """The per-stage placement, or ``None`` when every stage shares
+        the global replica mesh."""
+        return self._stage_meshes
+
+    @property
+    def placed(self) -> bool:
+        return self._stage_meshes is not None
+
     # -- build -------------------------------------------------------------
     def _check_batch(self, batch) -> None:
-        n = self._mesh.devices.size
+        n = self._replicas
         for leaf in jax.tree_util.tree_leaves(batch):
             axis = int(leaf.shape[0])
             if axis % self._m != 0:
@@ -488,20 +607,21 @@ class _PipelineStep:
         params = self._chain._check_params(params)
         self._check_batch(batch)
         leaves, self._treedef = jax.tree_util.tree_flatten(list(params))
-        seg_avals = [[SimpleNamespace(shape=tuple(x.shape),
-                                      dtype=jnp.dtype(x.dtype))
-                      for x in jax.tree_util.tree_leaves(p)]
-                     for p in params]
-        thr = self._fusion_threshold
-        if thr is None:
-            try:
-                thr = int(st.coordinator.fusion_threshold)
-            except Exception:  # noqa: BLE001 — size-check contexts
-                thr = _fusion_threshold_bytes()
-        self._bucket_plan = _build_plan(seg_avals, int(thr))
+        if self._stage_meshes is None:
+            seg_avals = [[SimpleNamespace(shape=tuple(x.shape),
+                                          dtype=jnp.dtype(x.dtype))
+                          for x in jax.tree_util.tree_leaves(p)]
+                         for p in params]
+            thr = self._fusion_threshold
+            if thr is None:
+                try:
+                    thr = int(st.coordinator.fusion_threshold)
+                except Exception:  # noqa: BLE001 — size-check contexts
+                    thr = _fusion_threshold_bytes()
+            self._bucket_plan = _build_plan(seg_avals, int(thr))
         self._preflight(params, batch)
         self._build_programs()
-        self._apply = self._build_apply()
+        self._apply = self._build_apply(params)
 
     def _preflight(self, params, batch) -> None:
         """hvd-mem pre-flight (docs/memory.md): size the schedule's
@@ -559,7 +679,12 @@ class _PipelineStep:
         S, m = self._S, self._m
         sm = _compat.shard_map
         R = P(REPLICA_AXIS)
-        mesh = self._mesh
+
+        def mesh_of(k: int):
+            # Placed: stage k's executables live on stage k's sub-mesh.
+            if self._stage_meshes is not None:
+                return self._stage_meshes[k]
+            return self._mesh
 
         def mb_slice(batch, i):
             def sl(x):
@@ -589,14 +714,15 @@ class _PipelineStep:
 
         self._fwd: List[Callable] = [None] * S
         self._fwd[0] = _AotProgram("pipeline/F0", jax.jit(
-            sm(fwd0, mesh=mesh, in_specs=(P(), R, P()), out_specs=R,
-               check_vma=False)))
+            sm(fwd0, mesh=mesh_of(0), in_specs=(P(), R, P()),
+               out_specs=R, check_vma=False)))
         for k in range(1, S - 1):
             self._fwd[k] = _AotProgram(f"pipeline/F{k}", jax.jit(
-                sm(make_fwd(k), mesh=mesh, in_specs=(P(), R, R, P()),
-                   out_specs=R, check_vma=False)))
+                sm(make_fwd(k), mesh=mesh_of(k),
+                   in_specs=(P(), R, R, P()), out_specs=R,
+                   check_vma=False)))
         self._fwd[S - 1] = _AotProgram(f"pipeline/F{S - 1}", jax.jit(
-            sm(fwd_last, mesh=mesh, in_specs=(P(), R, R, P()),
+            sm(fwd_last, mesh=mesh_of(S - 1), in_specs=(P(), R, R, P()),
                out_specs=P(), check_vma=False)))
 
         # Backward programs: jax.vjp with in-segment rematerialization
@@ -641,31 +767,32 @@ class _PipelineStep:
                 return g
             return bwd
 
-        def jit_b(name, fn, in_specs, out_specs, donate):
+        def jit_b(name, k, fn, in_specs, out_specs, donate):
             return _AotProgram(name, jax.jit(
-                sm(fn, mesh=mesh, in_specs=in_specs,
+                sm(fn, mesh=mesh_of(k), in_specs=in_specs,
                    out_specs=out_specs, check_vma=False),
                 donate_argnums=donate), donate=donate)
 
         self._bwd: List[Callable] = [None] * S
         self._bwd_acc: List[Callable] = [None] * S
-        self._bwd[S - 1] = jit_b(f"pipeline/B{S - 1}",
+        self._bwd[S - 1] = jit_b(f"pipeline/B{S - 1}", S - 1,
                                  make_bwd_last(False),
                                  (P(), R, R, P()), (R, R), (1,))
-        self._bwd_acc[S - 1] = jit_b(f"pipeline/B{S - 1}acc",
+        self._bwd_acc[S - 1] = jit_b(f"pipeline/B{S - 1}acc", S - 1,
                                      make_bwd_last(True),
                                      (P(), R, R, P(), R), (R, R), (1, 4))
         for k in range(1, S - 1):
-            self._bwd[k] = jit_b(f"pipeline/B{k}",
+            self._bwd[k] = jit_b(f"pipeline/B{k}", k,
                                  make_bwd_mid(k, False),
                                  (P(), R, R, P(), R), (R, R), (1, 4))
-            self._bwd_acc[k] = jit_b(f"pipeline/B{k}acc",
+            self._bwd_acc[k] = jit_b(f"pipeline/B{k}acc", k,
                                      make_bwd_mid(k, True),
                                      (P(), R, R, P(), R, R), (R, R),
                                      (1, 4, 5))
-        self._bwd[0] = jit_b("pipeline/B0", make_bwd_first(False),
+        self._bwd[0] = jit_b("pipeline/B0", 0, make_bwd_first(False),
                              (P(), R, P(), R), R, (3,))
-        self._bwd_acc[0] = jit_b("pipeline/B0acc", make_bwd_first(True),
+        self._bwd_acc[0] = jit_b("pipeline/B0acc", 0,
+                                 make_bwd_first(True),
                                  (P(), R, P(), R, R), R, (3, 4))
 
         self._loss_mean = jax.jit(lambda xs: jnp.mean(jnp.stack(xs)))
@@ -674,14 +801,12 @@ class _PipelineStep:
         # transfers per step would sit right on it.
         self._mb_idx = [jnp.asarray(i, jnp.int32) for i in range(m)]
 
-    def _build_apply(self) -> Callable:
+    def _build_apply(self, params) -> Optional[Callable]:
         optimizer = self._optimizer
         average = self._average
         m = self._m
 
-        def apply_body(grads_pr, opt_state, params):
-            g = jax.tree_util.tree_map(
-                lambda x: jnp.squeeze(x, 0), grads_pr)
+        def scale(g, opt_state, stage_params):
             leaves, tdef = jax.tree_util.tree_flatten(g)
             # Accumulated as RAW per-microbatch per-replica sums; the
             # mean-loss gradient divides by microbatches × replicas
@@ -692,19 +817,66 @@ class _PipelineStep:
                                              REPLICA_AXIS)
             leaves = [x / denom.astype(x.dtype) for x in leaves]
             g = jax.tree_util.tree_unflatten(tdef, leaves)
-            updates, opt_state = optimizer.update(g, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state
+            updates, opt_state = optimizer.update(g, opt_state,
+                                                  stage_params)
+            return optax.apply_updates(stage_params, updates), opt_state
 
-        donate = (0, 1, 2) if self._donate else (0,)
-        return jax.jit(_compat.shard_map(
-            apply_body, mesh=self._mesh,
-            in_specs=(P(REPLICA_AXIS), P(), P()), out_specs=(P(), P()),
-            check_vma=False), donate_argnums=donate)
+        if self._stage_meshes is None:
+            def apply_body(grads_pr, opt_state, prm):
+                g = jax.tree_util.tree_map(
+                    lambda x: jnp.squeeze(x, 0), grads_pr)
+                return scale(g, opt_state, prm)
+
+            donate = (0, 1, 2) if self._donate else (0,)
+            return jax.jit(_compat.shard_map(
+                apply_body, mesh=self._mesh,
+                in_specs=(P(REPLICA_AXIS), P(), P()),
+                out_specs=(P(), P()), check_vma=False),
+                donate_argnums=donate)
+
+        # Placed: one fused reduce+apply program PER STAGE on the
+        # stage's own sub-mesh — the cross-replica psum happens inside
+        # the same executable as the optimizer update (hvd-fuse), so
+        # the 1F1B scheduler can dispatch it the moment the stage's
+        # last backward is in flight and the reduction streams into
+        # the other stages' remaining ticks.
+        def apply_body(grads_pr, opt_state, prm):
+            g = jax.tree_util.tree_map(
+                lambda x: jnp.squeeze(x, 0), grads_pr)
+            g = jax.lax.psum(g, REPLICA_AXIS)
+            return scale(g, opt_state, prm)
+
+        self._apply_s = []
+        for k, mk in enumerate(self._stage_meshes):
+            jitted = jax.jit(_compat.shard_map(
+                apply_body, mesh=mk,
+                in_specs=(P(REPLICA_AXIS), P(), P()),
+                out_specs=(P(), P()), check_vma=False))
+            launch_bytes = sum(
+                _mem_planner.fused_group_bytes(tuple(leaf.shape), 1,
+                                               dtype=leaf.dtype)
+                for leaf in jax.tree_util.tree_leaves(params[k]))
+            self._apply_s.append(_fused.FusedProgram(
+                f"pipeline/apply{k}", jitted, mesh=mk, chunks=1,
+                launch_bytes=launch_bytes))
+        return None
 
     # -- execution ---------------------------------------------------------
     def __call__(self, params, opt_state, batch):
         if not self._built:
             self._build(params, batch)
+        if self._stage_meshes is not None:
+            if (not isinstance(opt_state, (list, tuple))
+                    or len(opt_state) != self._S):
+                raise ValueError(
+                    "stage_meshes placement needs a PER-STAGE opt_state "
+                    "sequence (e.g. [optimizer.init(p) for p in "
+                    f"params]); got {type(opt_state).__name__} for "
+                    f"{self._S} stages")
+            params = [_to_mesh(p, mk, P())
+                      for p, mk in zip(params, self._stage_meshes)]
+            opt_state = [_to_mesh(o, mk, P())
+                         for o, mk in zip(opt_state, self._stage_meshes)]
         return self._run(list(params), opt_state, batch)
 
     def _run(self, params, opt_state, batch):
@@ -716,6 +888,14 @@ class _PipelineStep:
         S, m = self._S, self._m
         plan = self._plan
         stream = plan.schedule == "1f1b"
+        meshes = self._stage_meshes
+        R = P(REPLICA_AXIS)
+        if meshes is not None:
+            # Each stage reads microbatches from its own sub-mesh copy
+            # of the batch (one transfer per stage per step, off the
+            # per-tick critical path).
+            batches = [_to_mesh(batch, mk, R) for mk in meshes]
+            applied: List = [None] * S
         window = _InflightWindow(_max_inflight()) if self._cpu_mesh \
             else None
         carries = {}          # (stage, mb) -> boundary activation
@@ -723,7 +903,8 @@ class _PipelineStep:
         cts = {}              # (stage, mb) -> cotangent from stage's B
         accs: List = [None] * S
         losses: List = [None] * m
-        handles: List[Optional[int]] = [None] * self._bucket_plan.n_leaves
+        handles: List[Optional[int]] = [None] * (
+            0 if self._bucket_plan is None else self._bucket_plan.n_leaves)
         live = peak = 0
         live_b = peak_b = 0
         mem_on = _mem.enabled()
@@ -750,21 +931,42 @@ class _PipelineStep:
                 _mem.ledger.free("pipeline.activations", nb)
             return out
 
+        def stage_batch(s):
+            return batch if meshes is None else batches[s]
+
+        def carry_in(s, mb):
+            # Stage s's input carry; placed mode moves it onto stage
+            # s's sub-mesh ONCE (the stored copy serves s's backward
+            # too).
+            c = carries[(s - 1, mb)]
+            if meshes is not None:
+                c = _to_mesh(c, meshes[s], R)
+                carries[(s - 1, mb)] = c
+            return c
+
+        def ct_in(s, mb):
+            ct = cts.pop((s + 1, mb))
+            if meshes is not None:
+                ct = _to_mesh(ct, meshes[s], R)
+            return ct
+
         for tick in plan.ticks:
             for a in tick:
                 i = self._mb_idx[a.mb]
                 s = a.stage
                 if a.phase == "F":
                     if s == 0:
-                        out = self._fwd[0](params[0], batch, i)
+                        out = self._fwd[0](params[0], stage_batch(0), i)
                         born((0, a.mb), out)
                         live += 1
                     elif s == S - 1:
                         out = losses[a.mb] = self._fwd[s](
-                            params[s], carries[(s - 1, a.mb)], batch, i)
+                            params[s], carry_in(s, a.mb),
+                            stage_batch(s), i)
                     else:
                         out = self._fwd[s](
-                            params[s], carries[(s - 1, a.mb)], batch, i)
+                            params[s], carry_in(s, a.mb),
+                            stage_batch(s), i)
                         born((s, a.mb), out)
                         live += 1
                     peak = max(peak, live)
@@ -774,27 +976,37 @@ class _PipelineStep:
                     extra = (accs[s],) if accs[s] is not None else ()
                     if s == S - 1:
                         out = prog(params[s], consumed((s - 1, a.mb)),
-                                   batch, i, *extra)
+                                   stage_batch(s), i, *extra)
                         accs[s], cts[(s, a.mb)] = out
                         live -= 1
                     elif s == 0:
-                        out = accs[0] = prog(params[0], batch, i,
-                                             cts.pop((1, a.mb)), *extra)
+                        out = accs[0] = prog(params[0], stage_batch(0),
+                                             i, ct_in(0, a.mb), *extra)
                     else:
                         out = prog(params[s], consumed((s - 1, a.mb)),
-                                   batch, i, cts.pop((s + 1, a.mb)),
+                                   stage_batch(s), i, ct_in(s, a.mb),
                                    *extra)
                         accs[s], cts[(s, a.mb)] = out
                         live -= 1
                     if stream and a.mb == m - 1:
-                        # This stage's LAST backward: its buckets
-                        # negotiate/replay NOW, as partial cycles —
-                        # the reduction streams into the other
-                        # stages' remaining ticks (the bubble).
-                        dispatch_bucket_segment(
-                            self._prefix, self._bucket_plan.segments[s],
-                            jax.tree_util.tree_leaves(accs[s]),
-                            handles, tl)
+                        if meshes is not None:
+                            # Placed: the stage's fused reduce+apply
+                            # (in-program psum + optimizer update on
+                            # the stage sub-mesh) dispatches NOW —
+                            # the reduction streams into the other
+                            # stages' remaining ticks.
+                            applied[s] = self._apply_s[s](
+                                accs[s], opt_state[s], params[s])
+                        else:
+                            # This stage's LAST backward: its buckets
+                            # negotiate/replay NOW, as partial cycles —
+                            # the reduction streams into the remaining
+                            # schedule ticks (the bubble).
+                            dispatch_bucket_segment(
+                                self._prefix,
+                                self._bucket_plan.segments[s],
+                                jax.tree_util.tree_leaves(accs[s]),
+                                handles, tl)
                 if window is not None:
                     window.admit(out)
 
@@ -809,18 +1021,29 @@ class _PipelineStep:
         if not stream:
             # GPipe-ordered comparator: reduction serialized after the
             # full flush — fence every accumulated gradient, then
-            # dispatch the same buckets.
+            # dispatch the same reductions (buckets, or the per-stage
+            # fused reduce+apply programs under placement).
             jax.block_until_ready([jax.tree_util.tree_leaves(acc)
                                    for acc in accs])
             for s in range(S):
-                dispatch_bucket_segment(
-                    self._prefix, self._bucket_plan.segments[s],
-                    jax.tree_util.tree_leaves(accs[s]), handles, tl)
+                if meshes is not None:
+                    applied[s] = self._apply_s[s](
+                        accs[s], opt_state[s], params[s])
+                else:
+                    dispatch_bucket_segment(
+                        self._prefix, self._bucket_plan.segments[s],
+                        jax.tree_util.tree_leaves(accs[s]), handles, tl)
 
-        from ..ops import collective as C
+        if meshes is not None:
+            new_params = [a[0] for a in applied]
+            new_opt = [a[1] for a in applied]
+            jax.block_until_ready(
+                jax.tree_util.tree_leaves(new_params))
+        else:
+            from ..ops import collective as C
 
-        reduced = [C.take_async(h) for h in handles]
-        jax.block_until_ready(reduced)
+            reduced = [C.take_async(h) for h in handles]
+            jax.block_until_ready(reduced)
         if _telemetry.enabled():
             _M_BUBBLE.observe(time.perf_counter() - t0)
             _M_MICROBATCHES.inc(m)
@@ -828,8 +1051,10 @@ class _PipelineStep:
             _M_INFLIGHT_BYTES.set(peak_b)
         if mem_on:
             _mem.ledger.note_step()
-        red_tree = jax.tree_util.tree_unflatten(self._treedef, reduced)
         loss = self._loss_mean(losses)
+        if meshes is not None:
+            return new_params, new_opt, loss
+        red_tree = jax.tree_util.tree_unflatten(self._treedef, reduced)
         new_params, opt_state = self._apply(red_tree, opt_state, params)
         return new_params, opt_state, loss
 
@@ -845,6 +1070,7 @@ def make_pipeline_train_step(
     average: bool = True,
     fusion_threshold: Optional[int] = None,
     donate: bool = False,
+    stage_meshes: Optional[Sequence] = None,
 ):
     """Build the host-scheduled MPMD pipeline train step.
 
@@ -874,6 +1100,16 @@ def make_pipeline_train_step(
       fusion_threshold: per-stage bucket granularity in bytes
         (defaults to the coordinator's live threshold).
       donate: donate params/opt_state into the apply program.
+      stage_meshes: optional per-stage sub-mesh placement (one mesh
+        per stage, e.g. from :func:`stage_submeshes`) — the mp ×
+        pipeline composition.  Each stage's executables compile over
+        its own sub-mesh (which may carry a model axis on top of the
+        replica axis), boundary carries/cotangents move between
+        sub-meshes on the host, and each stage's gradients reduce
+        through its own fused reduce+apply program instead of the
+        dynamic bucket path.  Requires ``opt_state`` to be a
+        per-stage sequence (``[optimizer.init(p) for p in params]``);
+        ``donate`` then covers the backward programs only.
 
     Returns:
       ``step(params, opt_state, batch) -> (params, opt_state, loss)``
@@ -883,7 +1119,7 @@ def make_pipeline_train_step(
     """
     return _PipelineStep(stages, optimizer, mesh, num_microbatches,
                          schedule, interleave, average, fusion_threshold,
-                         donate)
+                         donate, stage_meshes)
 
 
 # ---------------------------------------------------------------------------

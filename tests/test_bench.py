@@ -143,6 +143,43 @@ def test_bench_dataplane_mode_contract_and_gates():
     assert tel["counters"].get("compression.ratio", 0) >= 1.0, tel
 
 
+def test_bench_fused_mode_contract_and_gates():
+    """`--mode fused` (this round): the hvd-fuse microbench emits one
+    contract JSON line — CPU-only like the other microbenches — and
+    must clear the DETERMINISTIC gates: every fused program bitwise-
+    identical to its unfused reference, exactly ONE XLA dispatch per
+    fused group on both legs, and the HVD_TPU_FUSE=off fallback pinning
+    the reference bytes.  The exposed-communication strictly-below gate
+    is wall-clock (XLA:CPU thunk-runtime overlap under a loaded tier-1
+    box is not guaranteed) — it lives in the CI `fused-bench` job; here
+    only the measurement's presence and shape are asserted."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "fused"],
+        env=dict(os.environ), cwd=REPO, capture_output=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    lines = [ln for ln in proc.stdout.decode().splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1, proc.stdout.decode()
+    payload = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "exposed_comm",
+                "bitwise", "dispatches_per_fused_group", "chunks"):
+        assert key in payload, payload
+    assert payload["metric"] == "fused_exposed_comm_us"
+    for name, ok in payload["bitwise"].items():
+        assert ok is True, (name, payload["bitwise"])
+    for leg, disp in payload["dispatches_per_fused_group"].items():
+        assert disp == 1, (leg, payload["dispatches_per_fused_group"])
+    ec = payload["exposed_comm"]
+    for key in ("unfused_us", "fused_us", "hidden_pct",
+                "strictly_below"):
+        assert key in ec, ec
+    assert ec["unfused_us"] >= 0 and ec["fused_us"] >= 0
+    assert payload["chunks"] >= 1
+    tel = payload["telemetry"]
+    assert tel["groups_compiled"] >= 1 and tel["launches"] >= 1, tel
+
+
 def test_bench_input_mode_contract_and_identity():
     """`--mode input` (this round): the input-pipeline microbench emits
     one contract JSON line — CPU-only like the other microbenches — and

@@ -80,6 +80,77 @@ def test_aux_loss_is_finite_and_positive():
     assert float(aux) > 0.0
 
 
+# ---------------------------------------------------------------------------
+# _top_k_dispatch edge cases (hvd-fuse satellite): pinned BEFORE the
+# fused rewrite — the routing arithmetic is the part the chunked hot
+# path must preserve exactly, so these run against the function
+# directly (no mesh, no collectives).
+# ---------------------------------------------------------------------------
+
+def test_top_k_dispatch_capacity_one_admits_first_token_only():
+    from horovod_tpu.parallel.expert import _top_k_dispatch
+
+    # Both tokens prefer expert 0; capacity 1 admits only the earlier
+    # token (cumsum order) and drops the other.
+    probs = jnp.asarray([[0.9, 0.1],
+                         [0.8, 0.2]], jnp.float32)
+    dispatch, combine, dropped = _top_k_dispatch(probs, k=1, capacity=1)
+    assert dispatch.shape == (2, 2, 1)
+    assert float(dispatch[0, 0, 0]) == 1.0   # token 0 admitted
+    assert float(dispatch[1, 0, 0]) == 0.0   # token 1 over capacity
+    assert float(jnp.sum(dispatch[1])) == 0.0
+    # Combine carries the gate for the admitted token only.
+    assert float(combine[0, 0, 0]) == pytest.approx(0.9)
+    assert float(jnp.sum(combine[1])) == 0.0
+    assert float(dropped) == pytest.approx(0.5)
+
+
+def test_top_k_dispatch_all_dropped_token_contributes_zero():
+    from horovod_tpu.parallel.expert import _top_k_dispatch
+
+    # Three tokens all racing for expert 0 at capacity 1 with k=1:
+    # tokens 1 and 2 lose every round — their dispatch AND combine rows
+    # must be exactly zero (the all-dropped token's output is zero, not
+    # stale buffer content).
+    probs = jnp.asarray([[0.99, 0.01],
+                         [0.98, 0.02],
+                         [0.97, 0.03]], jnp.float32)
+    dispatch, combine, dropped = _top_k_dispatch(probs, k=1, capacity=1)
+    assert float(jnp.sum(dispatch[1])) == 0.0
+    assert float(jnp.sum(dispatch[2])) == 0.0
+    assert float(jnp.sum(combine[1])) == 0.0
+    assert float(jnp.sum(combine[2])) == 0.0
+    assert float(dropped) == pytest.approx(2.0 / 3.0)
+
+
+def test_top_k_dispatch_top_k_equals_num_experts():
+    from horovod_tpu.parallel.expert import _top_k_dispatch
+
+    # k == E with ample capacity: every token reaches every expert
+    # exactly once, each expert's buffer slots fill without collision
+    # (admission order interleaves the k greedy rounds, so positions
+    # are a permutation of the slots, not token order), and the combine
+    # weights are the full softmax row (sum = 1 per token).
+    tokens, experts, capacity = 4, 3, 4
+    key = jax.random.PRNGKey(3)
+    probs = jax.nn.softmax(jax.random.normal(key, (tokens, experts)),
+                           axis=-1)
+    dispatch, combine, dropped = _top_k_dispatch(probs, k=experts,
+                                                 capacity=capacity)
+    assert float(dropped) == 0.0
+    # One slot per (token, expert) pair.
+    per_pair = jnp.sum(dispatch, axis=-1)
+    assert bool(jnp.all(per_pair == 1.0))
+    # Every expert buffer fills its slots exactly once (a permutation).
+    pos = jnp.argmax(dispatch, axis=-1)  # [t, E]
+    for e in range(experts):
+        assert sorted(int(p) for p in pos[:, e]) == list(range(tokens))
+    # Combine weight per (token, expert) is that pair's gate.
+    gates = jnp.sum(combine, axis=-1)
+    assert jnp.max(jnp.abs(gates - probs)) < 1e-6
+    assert bool(jnp.all(jnp.abs(jnp.sum(gates, axis=-1) - 1.0) < 1e-6))
+
+
 def test_moe_gradients_flow_to_all_param_groups():
     x, params = _inputs(tokens=32)
     mesh = make_mesh(expert=4, devices=jax.devices()[:4])

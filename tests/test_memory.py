@@ -38,6 +38,18 @@ def fresh_ledger():
     return ledger_mod.MemoryLedger()
 
 
+@pytest.fixture(autouse=True)
+def _zero_prefetch_category():
+    """Order-independence for the prefetch accounting tests: a stager
+    from an earlier test (any module — prefetch_to_device charges the
+    process-global ledger) can land its final put() after that test's
+    drain window, leaving a stale "input.prefetch" residue that skews
+    this module's peak/zero assertions.  Pin the category to zero on
+    entry so every test starts from its own charges only."""
+    ledger_mod.ledger.set("input.prefetch", 0)
+    yield
+
+
 # ---------------------------------------------------------------------------
 # Ledger
 # ---------------------------------------------------------------------------
@@ -514,8 +526,6 @@ def test_dataplane_plan_matches_ledger_watermark(hvd):
     """Framework-owned prediction within ±15 % of the measured ledger
     high-watermark for the dataplane workload (the acceptance gate;
     bench.py --mode memory runs the same comparison)."""
-    from horovod_tpu.ops import megakernel as mk
-
     tensors, elems = 8, 128
     n = hvd.size()
     rng = np.random.default_rng(3)
@@ -523,15 +533,13 @@ def test_dataplane_plan_matches_ledger_watermark(hvd):
             for _ in range(tensors)]
     inputs = [hvd.shard(t) for t in base]
     led = ledger_mod.ledger
-    for attempt in range(8):
-        led.reset()
-        launches0 = mk.stats.launches
-        hs = [hvd.allreduce_async(x, average=True,
-                                  name=f"mem.{attempt}.{j}")
+    led.reset()
+    # quiesce: one fused launch deterministically (the planner's model)
+    # — the drain tick can no longer split the submissions.
+    with hvd.quiesce():
+        hs = [hvd.allreduce_async(x, average=True, name=f"mem.{j}")
               for j, x in enumerate(inputs)]
-        _ = [hvd.synchronize(h) for h in hs]
-        if mk.stats.launches - launches0 == 1:
-            break  # single fused launch: the planner's model
+    _ = [hvd.synchronize(h) for h in hs]
     plan = planner.plan_dataplane(tensors, elems, n)
     measured = led.watermark()
     assert _within(plan.framework_bytes, measured), \

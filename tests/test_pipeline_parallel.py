@@ -501,3 +501,154 @@ def test_pipeline_single_stage_rejected(hvd):
     with pytest.raises(ValueError, match="at least 2 stages"):
         make_pipeline_train_step([_pipe_stage_last], optax.sgd(0.1),
                                  num_microbatches=2)
+
+
+# ---------------------------------------------------------------------------
+# Sub-mesh placement (mp × pipeline; hvd-fuse)
+# ---------------------------------------------------------------------------
+
+def _run_steps_placed(step, params, opt, batch, steps=2):
+    p, s = params, [opt.init(pp) for pp in params]
+    loss = None
+    for _ in range(steps):
+        p, s, loss = step(p, s, batch)
+    jax.block_until_ready(jax.tree_util.tree_leaves(p))
+    return p, float(loss)
+
+
+def _placed_batch(n_rep, m=4, per_mb=2, seed=1):
+    B = n_rep * m * per_mb
+    kx, ky = jax.random.split(jax.random.PRNGKey(seed))
+    return (jax.random.normal(kx, (B, _D)),
+            jax.random.normal(ky, (B, _D)))
+
+
+def test_stage_submeshes_split(hvd):
+    meshes = PL.stage_submeshes(4)
+    assert len(meshes) == 4
+    devs = [tuple(mk.devices.flat) for mk in meshes]
+    assert sum(len(d) for d in devs) == len(jax.devices())
+    assert len({d for block in devs for d in block}) == len(jax.devices())
+    mp = PL.stage_submeshes(2, model=2)
+    assert mp[0].shape["hvd"] == 2 and mp[0].shape["model"] == 2
+    with pytest.raises(ValueError, match="do not split"):
+        PL.stage_submeshes(3)
+    with pytest.raises(ValueError, match="not divisible by"):
+        PL.stage_submeshes(4, model=3)
+
+
+def test_pipeline_placed_1f1b_bitwise_equals_gpipe(hvd):
+    """The placement bitwise gate: per-stage executables on their own
+    sub-meshes, gradients through per-stage fused reduce+apply
+    programs — 1F1B (applies streamed at each stage's last backward)
+    reproduces the GPipe-ordered dispatch bit for bit."""
+    meshes = PL.stage_submeshes(4)
+    chain = _pipe_chain()
+    params = _pipe_params(jax.random.PRNGKey(0))
+    batch = _placed_batch(2)
+    opt = optax.adam(1e-3)
+    kw = dict(num_microbatches=4, stage_meshes=meshes)
+    step_f = make_pipeline_train_step(chain, opt, schedule="1f1b", **kw)
+    step_g = make_pipeline_train_step(chain, opt, schedule="gpipe", **kw)
+    p_f, l_f = _run_steps_placed(step_f, params, opt, batch, 3)
+    p_g, l_g = _run_steps_placed(step_g, params, opt, batch, 3)
+    assert step_f.placed and step_f.stage_meshes == meshes
+    assert l_f == l_g
+    assert _leaves_equal(p_f, p_g)
+
+
+def test_pipeline_placed_executables_live_on_declared_submeshes(hvd):
+    """Real MPMD placement: stage k's updated parameters come back
+    committed to exactly stage k's sub-mesh devices."""
+    meshes = PL.stage_submeshes(4)
+    chain = _pipe_chain()
+    params = _pipe_params(jax.random.PRNGKey(0))
+    batch = _placed_batch(2)
+    opt = optax.sgd(0.1)
+    step = make_pipeline_train_step(chain, opt, num_microbatches=4,
+                                    stage_meshes=meshes)
+    p1, _ = _run_steps_placed(step, params, opt, batch, 1)
+    for k, stage_params in enumerate(p1):
+        want = set(meshes[k].devices.flat)
+        for leaf in jax.tree_util.tree_leaves(stage_params):
+            assert set(leaf.sharding.device_set) == want, k
+
+
+def test_pipeline_placed_matches_unplaced_allclose(hvd):
+    """Placed and unplaced steps compute the same mean-loss SGD update
+    (allclose, not bitwise: the reduction arithmetic moves from the
+    dynamic bucket path over 8 replicas to an in-program psum over
+    each stage's 2)."""
+    chain = _pipe_chain()
+    params = _pipe_params(jax.random.PRNGKey(0))
+    batch = _placed_batch(8, per_mb=1)  # divides for both layouts
+    opt = optax.sgd(0.1)
+    step_u = make_pipeline_train_step(chain, opt, num_microbatches=4)
+    step_p = make_pipeline_train_step(chain, opt, num_microbatches=4,
+                                      stage_meshes=PL.stage_submeshes(4))
+    p_u, l_u = _run_steps(step_u, params, opt, batch, 1)
+    p_p, l_p = _run_steps_placed(step_p, params, opt, batch, 1)
+    np.testing.assert_allclose(l_p, l_u, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(p_p),
+                    jax.tree_util.tree_leaves(p_u)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def _mp_stage0(p, carry, b):
+    from horovod_tpu.parallel.tensor import local_shard, tp_mlp
+
+    x, _y = b
+    return tp_mlp(x, local_shard(p["w"], 1), None,
+                  local_shard(p["w2"], 0), None)
+
+
+def _mp_stage_last(p, carry, b):
+    _x, y = b
+    pred = carry @ p["w"] + p["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def test_pipeline_placed_mp_composition_bitwise(hvd):
+    """mp × pipeline: each stage's sub-mesh carries a model axis and
+    the stage body runs the fused tensor-parallel closers inside it —
+    1f1b ≡ gpipe stays bitwise under the composition."""
+    import horovod_tpu as H
+
+    meshes = PL.stage_submeshes(2, model=2)
+    chain = H.ChainedLoss([_mp_stage0, _mp_stage_last])
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    params = [
+        {"w": jax.random.normal(k1, (_D, _D)) * _D ** -0.5,
+         "w2": jax.random.normal(k2, (_D, _D)) * _D ** -0.5},
+        {"w": jax.random.normal(k3, (_D, _D)) * _D ** -0.5,
+         "b": jnp.zeros((_D,))},
+    ]
+    batch = _placed_batch(2)
+    opt = optax.sgd(0.1)
+    kw = dict(num_microbatches=4, stage_meshes=meshes)
+    step_f = make_pipeline_train_step(chain, opt, schedule="1f1b", **kw)
+    step_g = make_pipeline_train_step(chain, opt, schedule="gpipe", **kw)
+    p_f, l_f = _run_steps_placed(step_f, params, opt, batch, 2)
+    p_g, l_g = _run_steps_placed(step_g, params, opt, batch, 2)
+    assert np.isfinite(l_f)
+    assert l_f == l_g
+    assert _leaves_equal(p_f, p_g)
+
+
+def test_pipeline_placed_validation(hvd):
+    chain = _pipe_chain()
+    params = _pipe_params(jax.random.PRNGKey(0))
+    opt = optax.sgd(0.1)
+    with pytest.raises(ValueError, match="one sub-mesh per stage"):
+        make_pipeline_train_step(chain, opt, num_microbatches=4,
+                                 stage_meshes=PL.stage_submeshes(2))
+    bad = make_mesh(pipe=2, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="replica"):
+        make_pipeline_train_step(chain, opt, num_microbatches=4,
+                                 stage_meshes=[bad] * 4)
+    step = make_pipeline_train_step(chain, opt, num_microbatches=4,
+                                    stage_meshes=PL.stage_submeshes(4))
+    batch = _placed_batch(2)
+    with pytest.raises(ValueError, match="PER-STAGE opt_state"):
+        step(params, opt.init(params), batch)
