@@ -1799,7 +1799,10 @@ def _serving_bench(n_requests: int = 40, max_slots: int = 8,
     engine rollout equals the token-by-token argmax rollout of the
     jitted non-incremental ``serving_forward`` (``bitwise_identical``).
     CPU-only like ``--mode control``: no XLA collectives, no TPU
-    tunnel.
+    tunnel.  ``HVD_TPU_BENCH_SERVING_QUICK=1`` (the tier-1 test)
+    shrinks the traces — the deterministic gates hold at any trace
+    size, and the CI `serving-bench` job owns the full-size
+    throughput gates.
     """
     import jax
     import jax.numpy as jnp
@@ -1809,6 +1812,10 @@ def _serving_bench(n_requests: int = 40, max_slots: int = 8,
                                                 init_transformer,
                                                 serving_forward)
     from horovod_tpu.serving import InferenceEngine
+
+    quick = os.environ.get("HVD_TPU_BENCH_SERVING_QUICK") == "1"
+    if quick:
+        n_requests = 14
 
     # Sized so the decode dispatch dominates the per-iteration cost
     # (host-side sampling is constant per token and would otherwise
@@ -1880,9 +1887,10 @@ def _serving_bench(n_requests: int = 40, max_slots: int = 8,
     stat, stat_out = run(continuous=False)
     results_identical = cont_out == stat_out
 
-    prefix_section = _serving_prefix_bench(params, cfg,
-                                           max_slots=max_slots)
-    spec_section = _serving_spec_bench(max_slots=max_slots)
+    prefix_section = _serving_prefix_bench(
+        params, cfg, n_requests=10 if quick else 24, max_slots=max_slots)
+    spec_section = _serving_spec_bench(
+        n_requests=10 if quick else 24, max_slots=max_slots)
 
     # Bitwise contract: engine prefill+decode (cached executables) vs
     # the jitted non-incremental forward, as a greedy rollout.
@@ -2024,9 +2032,13 @@ def _serving_spec_bench(n_requests: int = 24, max_slots: int = 8,
     # compute): the economics speculative decoding monetizes — the
     # verify's per-token cost is ~C_decode/2 regardless of depth (width
     # scales with the block, amortization scales with it too), so the
-    # draft's relative cost decides the ceiling.
+    # draft's relative cost decides the ceiling.  Quick mode keeps the
+    # deterministic gates (bitwise agreement, dispatch contract) on a
+    # small target — the economics gate is CI-only, full-size.
+    quick = os.environ.get("HVD_TPU_BENCH_SERVING_QUICK") == "1"
     cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=8,
-                            n_layers=8, d_ff=1024, max_seq_len=128)
+                            n_layers=3 if quick else 8,
+                            d_ff=256 if quick else 1024, max_seq_len=128)
     dcfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=8,
                              n_layers=1, d_ff=64, max_seq_len=128)
     params, draft = agreement_pair(cfg, dcfg)
@@ -2081,13 +2093,20 @@ def _serving_spec_bench(n_requests: int = 24, max_slots: int = 8,
     # Best-of-2 per leg: the verdicts are deterministic (identical
     # completions every repeat — asserted), only the wall clock on a
     # shared box is not, and a transient load spike on either leg must
-    # not flip the CI gate.
+    # not flip the CI gate.  Quick mode runs each leg once — the
+    # repeat is pure wall-clock insurance for the CI speedup gate.
     spec, spec_out, spec_eng = run(speculative=True)
-    spec2, spec_out2, eng2 = run(speculative=True)
+    if quick:
+        spec2, spec_out2, eng2 = spec, spec_out, spec_eng
+    else:
+        spec2, spec_out2, eng2 = run(speculative=True)
     if spec2["tokens_per_sec"] > spec["tokens_per_sec"]:
         spec, spec_eng = spec2, eng2
     base, base_out, _ = run(speculative=False)
-    base2, base_out2, _ = run(speculative=False)
+    if quick:
+        base2, base_out2 = base, base_out
+    else:
+        base2, base_out2, _ = run(speculative=False)
     if base2["tokens_per_sec"] > base["tokens_per_sec"]:
         base = base2
     repeats_identical = (spec_out == spec_out2
@@ -2115,6 +2134,98 @@ def _serving_spec_bench(n_requests: int = 24, max_slots: int = 8,
         "propose_dispatches_per_iteration": calls["propose"],
         "eager_dispatches_per_iteration": eager,
         "requests": n_requests,
+    }
+
+
+def _tuning_bench(windows: int = 80) -> dict:
+    """hvd-tune convergence leg of ``--mode tuning``: the REAL policy
+    engine (tuning/policy.py, with the REAL hvd-mem pricing hook)
+    closed over a deterministic fleet model, started deliberately
+    mis-tuned — compression off on a simulated-DCN hierarchy, in-flight
+    depth 1, oversized spec_tokens on a low-acceptance draft.
+
+    The model is the paper's additive critical path: per-step
+    milliseconds = compute + dcn(wire format) + dispatch-gap(in-flight
+    depth) + speculative overhead(depth x miss rate).  Each decision
+    window synthesizes the leg attribution the sensors would measure
+    from that model and feeds it to the engine; an applied decision
+    changes the model's knobs, which changes the NEXT window's legs —
+    the closed loop, minus the hardware.  Gates (CI): converged
+    steps/sec >= 1.5x mis-tuned AND within 10% of the hand-tuned
+    reference, convergence within a bounded number of windows, and a
+    bit-identical decision sequence on replay (the engine is free of
+    wall clock and PRNG).  The separate actuation leg
+    (tests/test_tuning.py) covers the marker path on the real
+    runtime."""
+    from horovod_tpu.memory.planner import retune_delta_bytes
+    from horovod_tpu.tuning.policy import (PolicyEngine, WindowSnapshot)
+
+    COMPUTE_MS = 10.0
+    DCN_MS = {"none": 60.0, "bf16": 30.0, "int8": 14.0, "int4": 11.0}
+    # Dispatch-gap vs in-flight depth: queueing-shaped — the gap
+    # collapses once the window covers the dispatch latency.
+    GAP_MS = {1: 40.0, 2: 24.0, 4: 14.0, 8: 2.0}
+    ACCEPTANCE = 0.3
+    SPEC_MS_PER_MISS = 0.9
+
+    MIS_TUNED = {"dcn_compress": "none", "max_inflight": 1,
+                 "fusion_threshold": 64 << 20, "cycle_time": 0.005,
+                 "spec_tokens": 6}
+    HAND_TUNED = {"dcn_compress": "int4", "max_inflight": 8,
+                  "fusion_threshold": 64 << 20, "cycle_time": 0.005,
+                  "spec_tokens": 1}
+
+    def step_ms(k) -> float:
+        return (COMPUTE_MS + DCN_MS[k["dcn_compress"]]
+                + GAP_MS[k["max_inflight"]]
+                + SPEC_MS_PER_MISS * k["spec_tokens"]
+                * (1.0 - ACCEPTANCE))
+
+    def legs_of(k) -> dict:
+        # What trace/analyze.window_legs would attribute (busy µs).
+        return {"dispatch": COMPUTE_MS * 1e3,
+                "dcn": DCN_MS[k["dcn_compress"]] * 1e3,
+                "dispatch-gap": GAP_MS[k["max_inflight"]] * 1e3,
+                "host": 1e3}
+
+    def run_loop():
+        knobs = dict(MIS_TUNED)
+        eng = PolicyEngine(price=lambda knob, old, new, s:
+                           retune_delta_bytes(knob, old, new, s.knobs))
+        decisions, trail = [], []
+        for w in range(windows):
+            snap = WindowSnapshot(
+                index=w, legs=legs_of(knobs), knobs=dict(knobs),
+                spec_acceptance=ACCEPTANCE, headroom_frac=0.5,
+                headroom_bytes=8 << 30)
+            d = eng.step(snap)
+            if d is not None:
+                knobs[d.knob] = d.value  # the fleet applies the marker
+            decisions.append(None if d is None else
+                             (d.seq, d.window, d.knob, str(d.value)))
+            trail.append(round(step_ms(knobs), 4))
+        return knobs, [d for d in decisions if d], trail
+
+    knobs, decisions, trail = run_loop()
+    _, decisions2, _ = run_loop()
+
+    mis_sps = 1000.0 / step_ms(MIS_TUNED)
+    converged_sps = 1000.0 / trail[-1]
+    hand_sps = 1000.0 / step_ms(HAND_TUNED)
+    last_window = max((d[1] for d in decisions), default=0)
+    return {
+        "mis_tuned_steps_per_sec": round(mis_sps, 2),
+        "converged_steps_per_sec": round(converged_sps, 2),
+        "hand_tuned_steps_per_sec": round(hand_sps, 2),
+        "speedup": round(converged_sps / mis_sps, 2),
+        "vs_hand_tuned": round(converged_sps / hand_sps, 3),
+        "n_decisions": len(decisions),
+        "last_decision_window": last_window,
+        "windows": windows,
+        "deterministic_replay": decisions == decisions2,
+        "converged_knobs": {k: str(v) for k, v in sorted(knobs.items())},
+        "decisions": [f"w{w}: {knob}={val}"
+                      for _seq, w, knob, val in decisions],
     }
 
 
@@ -2184,7 +2295,7 @@ def main() -> int:
     ap.add_argument("--mode",
                     choices=["resnet", "control", "dataplane", "input",
                              "serving", "overlap", "pipeline",
-                             "memory", "fused"],
+                             "memory", "fused", "tuning"],
                     default="resnet",
                     help="control = control-plane negotiations/sec only "
                          "(no XLA, no TPU tunnel); dataplane = "
@@ -2213,7 +2324,11 @@ def main() -> int:
                          "computation-collective kernels — bitwise vs "
                          "the unfused reference, one-dispatch-per-"
                          "group, and exposed-communication strictly "
-                         "below the unfused leg (no TPU tunnel)")
+                         "below the unfused leg (no TPU tunnel); "
+                         "tuning = hvd-tune closed-loop convergence — "
+                         "the real policy engine + hvd-mem pricing "
+                         "over a deterministic mis-tuned fleet model "
+                         "(no XLA, no TPU tunnel)")
     ap.add_argument("--check-speedup", type=float, default=None,
                     help="control mode: exit nonzero when the cache-on/"
                          "cache-off speedup is below this bound; "
@@ -2467,6 +2582,36 @@ def main() -> int:
                     f"fused exposed communication "
                     f"{ec.get('fused_us')}us not strictly below the "
                     f"unfused leg's {ec.get('unfused_us')}us")
+            if failures:
+                for f in failures:
+                    print(f"FAIL: {f}", file=sys.stderr)
+                return 1
+        return 0
+
+    if args.mode == "tuning":
+        # Pure Python (policy engine + pricing formulas): no XLA, no
+        # mesh, no tunnel.
+        result = _tuning_bench()
+        print(json.dumps(result))
+        if args.check_speedup is not None:
+            failures = []
+            if (result.get("speedup") or 0.0) < args.check_speedup:
+                failures.append(
+                    f"tuned/mis-tuned speedup {result.get('speedup')}x "
+                    f"< required {args.check_speedup}x")
+            if (result.get("vs_hand_tuned") or 0.0) < 0.9:
+                failures.append(
+                    f"converged throughput is "
+                    f"{result.get('vs_hand_tuned')} of the hand-tuned "
+                    f"reference (required: within 10%)")
+            if (result.get("last_decision_window") or 0) > 60:
+                failures.append(
+                    f"last decision at window "
+                    f"{result.get('last_decision_window')} "
+                    f"(required: converged within 60 windows)")
+            if not result.get("deterministic_replay"):
+                failures.append("decision sequence not identical on "
+                                "replay")
             if failures:
                 for f in failures:
                     print(f"FAIL: {f}", file=sys.stderr)

@@ -143,6 +143,18 @@ SCENARIOS: List[Scenario] = [
         doc="np=3 chain: the LEAF's uplink to its interior parent is "
             "reset; it re-parents to the root and the stream replay "
             "keeps every cache replica aligned"),
+    # -- hvd-tune actuation (tuning/actuation.py) ------------------------
+    Scenario(
+        "retune_midfault", "cp", "recover",
+        spec="transport.reset:count=1:after=26:rank=1@33",
+        needle="session resumed",
+        env={"HVD_TPU_CHAOS_RETUNE_STEPS": "10,25"},
+        doc="hvd-tune RETUNE markers ride the response stream at steps "
+            "10 and 25; the worker's connection resets in the window "
+            "between a marker's broadcast and its apply boundary — the "
+            "session-resume replay must deliver the marker exactly "
+            "once (records identical to the fault-free pass: never "
+            "lost, never double-applied, fleet-coherent)"),
     # -- coordinator drain loop (ops/collective.py) ----------------------
     Scenario(
         "coord_tick_delay", "cp", "recover", cap=120.0,
@@ -339,6 +351,18 @@ def run_cp_controller(np_: int, port: int) -> None:
     ctrl = T.ControllerTransport(coord, np_, port, tree=_cp_layout(np_))
     ctrl.cache = cache
     records = []
+    # hvd-tune: the retune_midfault scenario injects RETUNE markers at
+    # fixed steps (HVD_TPU_CHAOS_RETUNE_STEPS); they ride the same
+    # broadcast as production markers (ops/collective._coordinator_tick)
+    # and every rank records (seq, token, RETUNE) on delivery — digest
+    # equality with the fault-free pass proves exactly-once,
+    # fleet-coherent application across the fault.
+    retune_steps = {int(v) for v in
+                    os.environ.get("HVD_TPU_CHAOS_RETUNE_STEPS",
+                                   "").replace(";", ",").split(",")
+                    if v.strip()}
+    retune_pending: List = []
+    retune_seq = 0
 
     def tick() -> List:
         if _chaos.active():
@@ -360,22 +384,28 @@ def run_cp_controller(np_: int, port: int) -> None:
                 lambda _psid: _THRESHOLD)
         else:
             replayed, groups, epoch, compact = [], [], 0, True
+        retunes, retune_pending[:] = list(retune_pending), []
         negotiated = coord.poll_responses({})
         if _chaos.active():
             negotiated = _chaos.maybe_reorder("coord.reorder",
                                               negotiated)
         resps = (([marker] if marker is not None else [])
-                 + replayed + negotiated)
-        n_other = (1 if marker is not None else 0) + len(negotiated)
+                 + retunes + replayed + negotiated)
+        n_other = ((1 if marker is not None else 0) + len(retunes)
+                   + len(negotiated))
+        # Controller cache observation BEFORE the broadcast — same
+        # ordering contract as the production drain loop: a worker's
+        # hit bit for a freshly broadcast entry may arrive before the
+        # send returns, and must find the entry already inserted.
+        replay_ids = frozenset(id(r) for r in replayed)
+        if cache is not None:
+            for r in resps:
+                cache.observe_response(r, replay=id(r) in replay_ids)
         if resps:
             if compact and groups and n_other == 0:
                 ctrl.broadcast_replay(groups, epoch)
             else:
                 ctrl.broadcast_responses(resps)
-        replay_ids = frozenset(id(r) for r in replayed)
-        if cache is not None:
-            for r in resps:
-                cache.observe_response(r, replay=id(r) in replay_ids)
         return resps
 
     names = set(_cp_names())
@@ -385,6 +415,13 @@ def run_cp_controller(np_: int, port: int) -> None:
     steps = _cp_steps()
     pull_step = (3 * steps) // 4
     for step in range(steps):
+        if step in retune_steps:
+            retune_pending.append(Response(
+                ResponseType.RETUNE,
+                tensor_names=[f"fusion_threshold={_THRESHOLD << 1}",
+                              "cycle_time=0.004"],
+                tensor_sizes=[retune_seq]))
+            retune_seq += 1
         for n in sorted(names):
             ctrl.submit(_cp_req(0, n))
         done: set = set()
@@ -396,6 +433,10 @@ def run_cp_controller(np_: int, port: int) -> None:
                     for n in r.tensor_names:
                         done.add(n)
                         records.append((step, n, r.response_type.name))
+                elif r.response_type == ResponseType.RETUNE:
+                    for n in r.tensor_names:
+                        records.append((int(r.tensor_sizes[0]), n,
+                                        "RETUNE"))
                 elif r.response_type == ResponseType.ERROR:
                     _diag(0, f"negotiation failed: {r.error_message}")
             if not withdrew and time.monotonic() > deadline:
@@ -478,6 +519,13 @@ def run_cp_worker(rank: int, port: int, np_: int = 2) -> None:
                     for n in r.tensor_names:
                         done.add(n)
                         records.append((step, n, r.response_type.name))
+                elif r.response_type == ResponseType.RETUNE:
+                    # hvd-tune marker: record the apply exactly as the
+                    # controller does — the recover digest proves
+                    # exactly-once delivery across the fault.
+                    for n in r.tensor_names:
+                        records.append((int(r.tensor_sizes[0]), n,
+                                        "RETUNE"))
                 elif r.response_type == ResponseType.ERROR:
                     _diag(rank,
                           f"negotiation failed: {r.error_message}")

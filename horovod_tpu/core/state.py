@@ -98,9 +98,13 @@ class _GlobalState:
     # Background tick period (reference 5 ms, operations.cc:1221; env
     # HOROVOD_CYCLE_TIME in milliseconds, the post-v0.13 name).
     tick_seconds: float = 0.005
-    # Autotuner (utils.autotune.Autotuner) when HOROVOD_AUTOTUNE=1;
-    # coordinator-side only — fusion decisions are made there.
+    # hvd-tune controller (tuning.Tuner) when HVD_TPU_TUNE=1 and/or the
+    # deprecated HOROVOD_AUTOTUNE=1 sweep alias; coordinator-side only —
+    # fusion decisions are made there.  ``autotuner`` is the same object
+    # under the round-4 name (the drain loop's record_bytes/maybe_step
+    # feed); ``tuner`` is the coordinator tick's RETUNE-marker source.
     autotuner: Any = None
+    tuner: Any = None
     # Registered process sets (ops.process_set.ProcessSet) by id; id 0
     # (the global set) is implicit and never stored here.  Registered/
     # removed by user threads, read by the drain tick and the
@@ -201,6 +205,11 @@ def init(devices=None) -> None:
     # the valid site/key list, not silently run a fault-free "chaos"
     # job (docs/chaos.md).
     _chaos_env.validate_env()
+    # hvd-tune: a typo'd window/pin knob must fail init, not the first
+    # decision window (docs/tuning.md).
+    from .. import tuning as _tuning
+
+    _tuning.validate_env()
 
     # Bootstrap the process cluster BEFORE the first device enumeration
     # (≙ MPI_Init_thread before MPI_Comm_rank, operations.cc:1173-1181).
@@ -316,30 +325,13 @@ def init(devices=None) -> None:
                 cache=_state.response_cache,
             )
 
-        # Autotune (HOROVOD_AUTOTUNE=1, post-v0.13 subsystem): explore
-        # (fusion_threshold, cycle_time) on the process that makes the
-        # fusion decisions — the coordinator.
-        if os.environ.get("HOROVOD_AUTOTUNE") == "1" \
-                and _state.coordinator is not None:
-            from ..utils.autotune import Autotuner
-
-            def _apply_tuning(threshold: int, cycle: float) -> None:
-                _state.fusion_threshold_bytes = threshold
-                _state.tick_seconds = cycle
-                if _state.coordinator is not None:
-                    _state.coordinator.set_fusion_threshold(threshold)
-                # Per-process-set coordinators fuse independently; push
-                # the committed threshold to them too, else set
-                # collectives keep the construction-time value.  Locked
-                # snapshot: this runs on the drain tick thread while a
-                # user thread may be registering/removing sets.
-                for ps in process_sets_snapshot():
-                    if ps.coordinator is not None:
-                        ps.coordinator.set_fusion_threshold(threshold)
-
-            _state.autotuner = Autotuner(_apply_tuning)
-        else:
-            _state.autotuner = None
+        # hvd-tune (HVD_TPU_TUNE=1; HOROVOD_AUTOTUNE=1 is the deprecated
+        # round-4 sweep alias): collector on every rank, controller on
+        # the process that makes the fusion decisions — the coordinator.
+        # Knob application rides RETUNE response-stream markers so every
+        # rank (including this one) applies at the same cycle boundary
+        # (tuning/actuation.py).
+        _tuning.install(_state)
 
         # hvd-trace: fresh span buffer + (step, cycle, trace_id)
         # context for this incarnation; rank 0 mints the run's trace
@@ -496,7 +488,8 @@ def shutdown() -> None:
         _state.bg_stop = None
         if _state.autotuner is not None:
             _state.autotuner.close()
-            _state.autotuner = None
+        _state.autotuner = None
+        _state.tuner = None
         for ps in _state.process_sets.values():
             ps.close()
         _state.process_sets = {}

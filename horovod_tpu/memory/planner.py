@@ -139,6 +139,49 @@ def prefetch_bytes(depth: int, batch_bytes: int) -> int:
     return (depth + 1) * batch_bytes
 
 
+def retune_delta_bytes(knob: str, old, new, knobs) -> int:
+    """hvd-tune candidate pricing (tuning/policy.py veto hook): the
+    predicted change in per-device live bytes if ``knob`` moves
+    ``old`` -> ``new``, from the same byte formulas the planner's
+    what-ifs use.  Positive = the candidate costs memory; the tuner
+    vetoes candidates whose cost exceeds the window's HBM headroom, so
+    a retune can never land on an OOM.
+
+    ``knobs`` is the current knob mapping (tuning.actuation
+    ``current_knobs``); it supplies the fusion threshold that bounds
+    both the fusion-buffer and the per-in-flight-step cost, and an
+    optional ``spec_token_bytes`` advertised by the serving engine."""
+    try:
+        threshold = int(knobs.get("fusion_threshold", 64 * 1024 * 1024))
+    except (TypeError, ValueError):
+        threshold = 64 * 1024 * 1024
+    try:
+        old_i, new_i = int(old or 0), int(new)
+    except (TypeError, ValueError):
+        return 0
+    if knob == "fusion_threshold":
+        # In + out fusion buffers, each bounded by the threshold
+        # (the same 2x model fusion_group_bytes charges).
+        return 2 * (new_i - old_i)
+    if knob == "max_inflight":
+        # Each extra in-flight step pins up to one dispatched fusion
+        # buffer of outputs (parallel/training._ThrottledStep holds the
+        # step's tree until it leaves the window).
+        return (new_i - old_i) * threshold
+    if knob == "spec_tokens":
+        # Per extra speculated token: the verify block's logits + draft
+        # KV append — advertised by the live engine when one is
+        # registered (serving/engine.py), else unpriceable (0).
+        try:
+            per_token = int(knobs.get("spec_token_bytes", 0) or 0)
+        except (TypeError, ValueError):
+            per_token = 0
+        return (new_i - old_i) * per_token
+    # Compression escalation narrows wire bytes and cycle_time is
+    # host-side only — neither ever costs device memory.
+    return 0
+
+
 def fused_group_bytes(out_shape: Tuple[int, ...], chunks: int,
                       dtype="float32", chunk_axis: int = 0) -> int:
     """Bytes one fused computation-collective launch holds live beyond

@@ -628,6 +628,14 @@ class ResponseCache:
                 elif disarm:
                     self._disarmed = True
             return
+        if rt == ResponseType.RETUNE:
+            # hvd-tune knob marker: cache entries stay valid (the
+            # negotiated outcome is knob-independent); the stale packing
+            # plans / compiled megakernels are dropped by the apply path
+            # (tuning/actuation.py) on every rank at this same stream
+            # position, so replicas never mix pre- and post-retune
+            # executables within one cycle.
+            return
         if rt == ResponseType.JOIN:
             with self._lock:
                 if self._disarmed:
@@ -664,13 +672,19 @@ class ResponseCache:
             for pos, name in enumerate(resp.tensor_names):
                 if name in self._by_name:
                     continue
+                staged = True
                 reqs = self._staged.pop(name, None)
                 if reqs is None:
+                    staged = False
                     reqs = {}
                     for grank, by_name in own_requests.items():
                         req = by_name.get(name)
                         if req is not None:
                             reqs[grank] = req
+                if os.environ.get("HVD_TPU_CACHE_DEBUG") == "1":
+                    self._log(f"insert entry {len(self._entries)} "
+                              f"{name!r} ranks={sorted(reqs)} "
+                              f"{'staged' if staged else 'fallback'}")
                 single = self._single_response(resp, pos)
                 sample = next(iter(reqs.values()), None)
                 entry = _Entry(

@@ -1465,6 +1465,16 @@ def _execute_response_inner(resp: Response, ops: List[_QueuedOp]) -> None:
     if resp.response_type == ResponseType.CACHE_FLUSH:
         return  # response-cache epoch marker; handled by observe_response
 
+    if resp.response_type == ResponseType.RETUNE:
+        # hvd-tune knob marker: every rank applies the carried knob
+        # values HERE — the same response-stream position fleet-wide —
+        # so env knobs, compiled-kernel caches and cache replicas flip
+        # at one cycle boundary (tuning/actuation.py).
+        from ..tuning import actuation as _actuation
+
+        _actuation.apply_marker(resp, st)
+        return
+
     if resp.response_type == ResponseType.ERROR:
         err = HorovodError(resp.error_message)
         for o in ops:
@@ -2131,6 +2141,14 @@ def _coordinator_tick(st):
             for r in replayed:
                 for n in r.tensor_names:
                     st.timeline.negotiate_end(n)
+    # hvd-tune: pending retune decisions become stream markers HERE, on
+    # the coordinator tick that owns stream ordering — after the flush
+    # marker (flush-before-anything), before replay/negotiation (so the
+    # knob flip never splits a cycle's responses).  They count as
+    # non-replay traffic below, forcing a full-frame broadcast.
+    retunes: List[Response] = []
+    if st.tuner is not None:
+        retunes = st.tuner.take_markers()
     negotiated = st.coordinator.poll_responses(meta)
     for set_ps in _state.process_sets_snapshot():
         if set_ps.coordinator is not None:
@@ -2146,9 +2164,10 @@ def _coordinator_tick(st):
     # tick's negotiations produce; replayed responses reference live
     # (post-flush) entries whenever a marker is present, so the order
     # [marker, replays, negotiated] is safe in every interleaving.
-    resps = ([marker] if marker is not None else []) + replayed + negotiated
+    resps = ([marker] if marker is not None else []) + retunes \
+        + replayed + negotiated
     return resps, groups, epoch, compact, \
-        (1 if marker is not None else 0) + len(negotiated), \
+        (1 if marker is not None else 0) + len(retunes) + len(negotiated), \
         frozenset(id(r) for r in replayed)
 
 
@@ -2198,6 +2217,18 @@ def _drain() -> None:
                         _trace.span("negotiate.tick", "negotiate",
                                     tick_t0, time.monotonic(),
                                     args={"responses": len(resps)})
+                    # The controller reaches its own cache stream
+                    # position BEFORE publishing the stream: a fast
+                    # worker can observe the frame, hit its fresh
+                    # replica entry and ship the hit bit back before
+                    # this thread returns from the send — the bit must
+                    # find the entry already inserted, or it is dropped
+                    # as unresolvable and the op stalls into a withdraw
+                    # (the roaming fault-free chaos-cp abandonment).
+                    if cache is not None:
+                        for resp in resps:
+                            cache.observe_response(
+                                resp, replay=id(resp) in replay_ids)
                     if compact and groups and n_other == 0:
                         # Pure cache replay: the steady-state frame —
                         # entry-index groups instead of full payloads.
@@ -2206,9 +2237,6 @@ def _drain() -> None:
                         tp.broadcast_responses(resps)
                 for resp in resps:
                     ops = _queue.take(resp.tensor_names)
-                    if cache is not None:
-                        cache.observe_response(
-                            resp, replay=id(resp) in replay_ids)
                     _execute_response(resp, ops)
                     if st.autotuner is not None:
                         st.autotuner.record_bytes(

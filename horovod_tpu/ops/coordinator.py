@@ -610,8 +610,15 @@ class Coordinator:
         # the last poll: in the cache steady state every request is
         # served as a hit, and polling an untouched impl every 5 ms tick
         # is pure overhead (the native impl's poll crosses ctypes).
-        # Benign flag race: cleared BEFORE the poll, so a concurrent
-        # submit is picked up next tick at the latest.
+        # ORDERING CONTRACT: the flag is set AFTER the impl call lands
+        # and cleared BEFORE the poll.  Either a concurrent clearing
+        # poll runs after the submit landed (and sees it), or the flag
+        # survives for the next poll — one explicit drain after a submit
+        # always observes it.  Setting the flag BEFORE the impl call is
+        # a lost wakeup: a tick between flag-set and submit-landing
+        # clears the flag, polls empty tables, and leaves the landed
+        # request invisible behind dirty=False (the roaming single-
+        # process "it would stall" HorovodError).
         self._impl_dirty = True
         self._tracker = (_program.ProgramTracker(size)
                          if _program.program_check_enabled() else None)
@@ -684,8 +691,12 @@ class Coordinator:
         # the enum stringifies at dump time, not here.
         _flight_record("submit", req.tensor_name, req.request_rank,
                        req.request_type)
-        self._impl_dirty = True
-        done = self._impl.submit(req)
+        try:
+            done = self._impl.submit(req)
+        finally:
+            # AFTER the impl call — see the _impl_dirty ordering
+            # contract in __init__.
+            self._impl_dirty = True
         if done and self.timeline is not None:
             self.timeline.negotiate_end(req.tensor_name)
         return done, False
@@ -703,10 +714,11 @@ class Coordinator:
         for req in orphans:
             try:
                 self._retain(req)
-                self._impl_dirty = True
                 self._impl.submit(req)
             except ValueError:
                 pass  # duplicate: the rank re-submitted meanwhile
+            finally:
+                self._impl_dirty = True
 
     def withdraw(self, name: str, rank: int) -> None:
         _M_WITHDRAWALS.inc()
@@ -718,8 +730,10 @@ class Coordinator:
             # the op group-wide with the standard diagnosis.
             self._resubmit(self.cache.flush(
                 f"withdraw of {name!r} by rank {rank}", broadcast=True))
-        self._impl_dirty = True
-        self._impl.withdraw(name, rank)
+        try:
+            self._impl.withdraw(name, rank)
+        finally:
+            self._impl_dirty = True
 
     def set_fusion_threshold(self, v: int) -> None:
         self._impl.set_fusion_threshold(v)

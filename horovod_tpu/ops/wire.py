@@ -144,7 +144,13 @@ class ResponseType(IntEnum):
     rank, hvd.join()'s return value).  CACHE_FLUSH is a response-cache
     epoch marker (ops/cache.py): it rides the broadcast response list so
     every rank flushes its cache replica at the same position of the
-    response stream; tensor_sizes carries [new_epoch, disarm_flag]."""
+    response stream; tensor_sizes carries [new_epoch, disarm_flag].
+    RETUNE is an hvd-tune knob-change marker (tuning/actuation.py): it
+    rides the same stream so every rank applies the new knob value at
+    the same cycle boundary; tensor_names carries ``["knob=value", ...]``
+    and tensor_sizes carries ``[decision_seq]``.  Both markers are
+    Python-constructed and broadcast by the Python transport, so the
+    native twin (native/wire.cc) never sees them and needs no mirror."""
 
     ALLREDUCE = 0
     ALLGATHER = 1
@@ -156,6 +162,7 @@ class ResponseType(IntEnum):
     REDUCESCATTER = 7
     ALLTOALL = 8
     CACHE_FLUSH = 9
+    RETUNE = 10
 
 
 # Device id of a host-resident tensor (≙ CPU_DEVICE_ID, common.h:28).

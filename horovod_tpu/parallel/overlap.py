@@ -360,6 +360,15 @@ class _InflightWindow:
     def __init__(self, depth: int):
         self._depth = depth
         self._q: collections.deque = collections.deque()
+        from ..tuning import actuation as _actuation
+
+        _actuation.register_inflight_window(self)
+
+    def resize(self, depth: int) -> None:
+        """hvd-tune live retune: a shrink drains down to the new depth
+        on the next ``admit`` — no flush here (the drain tick must never
+        block on device results)."""
+        self._depth = max(1, int(depth))
 
     def admit(self, tree) -> None:
         self._q.append(tree)

@@ -156,6 +156,15 @@ class _ThrottledStep:
         self._step_fn = step_fn
         self._depth = depth
         self._inflight = collections.deque()
+        from ..tuning import actuation as _actuation
+
+        _actuation.register_inflight_window(self)
+
+    def resize(self, depth: int) -> None:
+        """hvd-tune live retune: a shrink takes effect by draining down
+        to the new depth on the next call — no flush here (the drain
+        tick must never block on device results)."""
+        self._depth = max(1, int(depth))
 
     def __call__(self, *args, **kw):
         while len(self._inflight) >= self._depth:

@@ -240,6 +240,12 @@ class InferenceEngine:
             weakref.finalize(self, _mem_ledger.ledger.free,
                              "serving.draft_params",
                              key=self._draft_ledger_key)
+            # hvd-tune: armed speculative engines are live-retunable
+            # (set_spec_tokens rides RETUNE stream markers) and feed the
+            # controller's acceptance-rate sensor.
+            from ..tuning import actuation as _actuation
+
+            _actuation.register_spec_engine(self)
         self._buckets = [b for b in
                          (2 ** i for i in range(1, 31))
                          if b <= self.capacity]
@@ -1033,6 +1039,26 @@ class InferenceEngine:
         if not self._spec_proposed:
             return None
         return self._spec_accepted / self._spec_proposed
+
+    def set_spec_tokens(self, n: int) -> None:
+        """hvd-tune live retune (tuning/actuation.py): change the
+        speculative depth between iterations.  The propose/verify
+        programs are keyed by depth, so the next iteration compiles (or
+        reuses) the executables for the new block size — no flush."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"spec_tokens must be >= 1, got {n}")
+        self.spec_tokens = n
+
+    def spec_token_bytes(self) -> int:
+        """Per-spec-token byte cost for the hvd-mem pricing of
+        spec_tokens retunes: one target + one draft KV token column per
+        slot (the verify writes target KV for every proposed token)."""
+        per_tok = 0
+        for cache in (self.cache, self.draft_cache):
+            if cache is not None:
+                per_tok += cache.page_global_bytes // cache.page_size
+        return per_tok * self.max_slots
 
     # -- multi-host mirroring ---------------------------------------------
     def _multiprocess(self) -> bool:

@@ -49,7 +49,7 @@ _FLIGHT_TAIL_PREFIXES = ("collective.", "transport.", "host.",
                         "events.", "input.", "trace.", "chaos.",
                         "serving.", "pipeline.", "overlap.",
                         "checkpoint.", "handles.", "memory.",
-                        "analysis.")
+                        "analysis.", "tuning.")
 
 # Extra tail providers (keyed, replace-on-reregister): subsystems whose
 # dump-time truth lives OUTSIDE the registry (the hvd-mem ledger) merge

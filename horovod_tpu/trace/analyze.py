@@ -119,6 +119,28 @@ def _median(vals: List[float]) -> float:
     return vals[len(vals) // 2] if vals else 0.0
 
 
+def window_legs(events: List[dict]) -> Dict[str, float]:
+    """hvd-tune sensor surface: a raw in-memory span buffer
+    (``trace.export_events()``) -> busy µs per critical-path leg,
+    including the per-(step, cycle) wall-minus-busy residual booked as
+    ``dispatch-gap``.  Same leg model as :func:`analyze`, but windowed
+    and file-free — the online tuner calls this every decision window
+    instead of round-tripping ``dump_fleet_trace``."""
+    spans = [e for e in events if e.get("ph") == "X"]
+    legs = _decompose(spans)
+    groups: Dict[Tuple[int, int], List[dict]] = {}
+    for s in spans:
+        key = _key(s)
+        if key is not None:
+            groups.setdefault(key, []).append(s)
+    for ss in groups.values():
+        wall = (max(float(s["ts"]) + float(s.get("dur", 0.0)) for s in ss)
+                - min(float(s["ts"]) for s in ss))
+        busy = sum(_decompose(ss).values())
+        legs["dispatch-gap"] += max(0.0, wall - busy)
+    return legs
+
+
 def analyze(events: List[dict]) -> dict:
     """The full report over one merged trace (see module docstring for
     the model).  Deterministic: every aggregate is ordered and floats

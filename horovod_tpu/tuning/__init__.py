@@ -1,0 +1,90 @@
+"""hvd-tune: closed-loop online self-tuning (docs/tuning.md).
+
+The fleet retunes its own performance knobs from live trace + memory
+telemetry: **sensors** (sensors.py) fold the hvd-trace span buffer, the
+fleet skew tracker, the serving acceptance rate and the HBM ledger into
+a per-window diagnosis; the pure **policy** rule table (policy.py) maps
+diagnosis -> at most one knob delta per window, with hysteresis,
+per-knob cooldown and the hvd-mem planner's byte pricing as an OOM
+veto; **actuation** (actuation.py) rides every decision down the
+broadcast response stream as a RETUNE marker so all ranks apply at the
+same cycle boundary — fleet-coherent by construction, verified by the
+env-fingerprint digest every rank publishes over telemetry.
+
+Env contract:
+  HVD_TPU_TUNE=1             enable the closed loop (controller side)
+  HVD_TPU_TUNE_WINDOW=<n>    decision window in drain ticks (default 64)
+  HVD_TPU_TUNE_PIN=a,b       knobs the policy may never touch
+  HOROVOD_AUTOTUNE=1         DEPRECATED alias: the round-4 explore-then-
+                             commit sweep over (fusion_threshold,
+                             cycle_time), folded in as one rule on the
+                             same actuation path (its
+                             HOROVOD_AUTOTUNE_LOG/_WARMUP_SAMPLES/
+                             _SAMPLE_SECONDS contract is unchanged)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from .controller import Tuner
+from .policy import (COMPRESSION_LADDER, KNOB_NAMES, Decision, PolicyConfig,
+                     PolicyEngine, WindowSnapshot)
+
+__all__ = ["Tuner", "Decision", "PolicyConfig", "PolicyEngine",
+           "WindowSnapshot", "COMPRESSION_LADDER", "KNOB_NAMES",
+           "validate_env", "install"]
+
+
+def validate_env() -> None:
+    """Fail init — not the first decision window — on a malformed
+    hvd-tune knob, naming the valid vocabulary."""
+    tune = os.environ.get("HVD_TPU_TUNE", "")
+    if tune not in ("", "0", "1"):
+        raise ValueError(f"HVD_TPU_TUNE={tune!r}: expected 0 or 1")
+    window = os.environ.get("HVD_TPU_TUNE_WINDOW")
+    if window:
+        try:
+            if int(window) < 1:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"HVD_TPU_TUNE_WINDOW={window!r}: expected a positive "
+                f"integer (decision window in drain ticks)") from None
+    raw = os.environ.get("HVD_TPU_TUNE_PIN", "")
+    for pin in raw.replace(";", ",").split(","):
+        pin = pin.strip()
+        if pin and pin not in KNOB_NAMES:
+            raise ValueError(
+                f"HVD_TPU_TUNE_PIN names unknown knob {pin!r}: expected "
+                f"a comma-separated subset of {', '.join(KNOB_NAMES)}")
+
+
+def install(st) -> None:
+    """Wire hvd-tune into a freshly initialized runtime (core/state.init).
+
+    Every rank registers the telemetry collector (env-digest + per-knob
+    gauges ride FRAME_METRICS pulls); the process that owns negotiation
+    — rank 0 in multi-process mode, the only process otherwise —
+    additionally gets the controller when enabled.  The controller is
+    published BOTH as ``st.tuner`` (the coordinator tick's marker
+    source) and as ``st.autotuner`` (the drain loop's
+    record_bytes/maybe_step feed — the round-4 name, kept so the fold-in
+    changes no call site)."""
+    from . import actuation as _actuation
+
+    _actuation.install_collector()
+    st.tuner = None
+    st.autotuner = None
+    closed_loop = os.environ.get("HVD_TPU_TUNE") == "1"
+    sweep = os.environ.get("HOROVOD_AUTOTUNE") == "1"
+    if st.coordinator is None or not (closed_loop or sweep):
+        return
+    if sweep and not closed_loop:
+        print("[hvd-tune] HOROVOD_AUTOTUNE=1 is a deprecated alias: the "
+              "explore-then-commit sweep now runs inside the hvd-tune "
+              "controller (set HVD_TPU_TUNE=1 for the full closed loop)",
+              file=sys.stderr)
+    st.tuner = st.autotuner = Tuner(st, sweep=sweep,
+                                    closed_loop=closed_loop)

@@ -1,0 +1,268 @@
+"""hvd-tune actuation: fleet-coherent knob application.
+
+Decisions become :class:`~horovod_tpu.ops.wire.Response` markers of type
+``RETUNE`` that ride the broadcast response stream (the CACHE_FLUSH
+machinery generalized): rank 0's coordinator tick appends pending
+markers (ops/collective._coordinator_tick), every rank's executor
+applies them HERE at the same response-stream position
+(ops/collective._execute_response_inner), so env knobs, compiled-kernel
+caches and cache replicas flip at one cycle boundary — fleet-coherent by
+construction.  The marker's ``tensor_names`` carry ``knob=value`` tokens
+and ``tensor_sizes`` the decision sequence number.
+
+Verification rides telemetry: every rank publishes a stable integer
+digest of the SPMD env fingerprint (``tuning.env_digest`` gauge, fed by
+a collector so FRAME_METRICS pulls carry it); after an applied retune
+the rank-0 controller compares per-rank digests and rolls the knob back
+fleet-wide on divergence (tuning/controller.py).  A worker that missed a
+marker across a transport fault is also caught by the EXISTING literal
+env-fingerprint check the session-resume RECONNECT handshake re-runs
+(ops/transport.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+from .. import telemetry as _telemetry
+from ..ops.wire import Response, ResponseType
+from . import policy as _policy
+
+_M_APPLIED = _telemetry.counter(
+    "tuning.applied", "retune markers applied on this rank")
+
+# Live objects retuned in place (weak so actuation never extends their
+# lifetime): in-flight windows expose ``resize``; speculative serving
+# engines expose ``set_spec_tokens`` (serving/engine.py registers armed
+# engines at construction).
+_inflight_windows: "weakref.WeakSet" = weakref.WeakSet()
+_spec_engines: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_inflight_window(window) -> None:
+    _inflight_windows.add(window)
+
+
+def register_spec_engine(engine) -> None:
+    _spec_engines.add(engine)
+
+
+def spec_engines() -> List[object]:
+    return list(_spec_engines)
+
+
+# ---------------------------------------------------------------------------
+# Knob table: parse/format + current value + per-rank apply
+# ---------------------------------------------------------------------------
+
+def _parse_value(knob: str, raw: str):
+    if knob == _policy.KNOB_DCN_COMPRESS:
+        from ..ops import compression as _compression
+
+        _compression.resolve(raw)  # typo'd name -> ValueError, not a
+        return raw                 # half-applied fleet
+    if knob == _policy.KNOB_CYCLE_TIME:
+        v = float(raw)
+        if v <= 0:
+            raise ValueError(f"cycle_time must be > 0, got {v}")
+        return v
+    v = int(float(raw))  # autotune sweeps may format ints as floats
+    if v < 1:
+        raise ValueError(f"{knob} must be >= 1, got {v}")
+    return v
+
+
+def current_knobs(st) -> Dict[str, object]:
+    """The CURRENT knob values on this rank — the policy's deltas start
+    here, and the per-knob gauges publish them (docs/metrics.md)."""
+    from ..ops import compression as _compression
+
+    dcn = (os.environ.get("HVD_TPU_DCN_COMPRESS")
+           or os.environ.get(_compression.DEFAULT_ENV) or "none")
+    try:
+        inflight = max(1, int(os.environ.get("HVD_TPU_MAX_INFLIGHT", "2")))
+    except ValueError:
+        inflight = 2
+    try:
+        spec = int(os.environ.get("HVD_TPU_SPEC_TOKENS", "3"))
+    except ValueError:
+        spec = 3
+    knobs: Dict[str, object] = {
+        _policy.KNOB_DCN_COMPRESS: dcn,
+        _policy.KNOB_MAX_INFLIGHT: inflight,
+        _policy.KNOB_FUSION_THRESHOLD: int(st.fusion_threshold_bytes),
+        _policy.KNOB_CYCLE_TIME: float(st.tick_seconds),
+        _policy.KNOB_SPEC_TOKENS: spec,
+    }
+    # A live speculative engine advertises its per-token verify cost so
+    # the planner can price spec_tokens moves (memory/planner.py).
+    for engine in spec_engines():
+        per_tok = getattr(engine, "spec_token_bytes", None)
+        if callable(per_tok):
+            try:
+                knobs["spec_token_bytes"] = int(per_tok())
+            except Exception:  # noqa: BLE001 — pricing is best-effort
+                pass
+            break
+    return knobs
+
+
+def _apply_dcn_compress(st, value: str) -> None:
+    os.environ["HVD_TPU_DCN_COMPRESS"] = value
+    # The compiled megakernels are keyed by WireFormat — a new wire
+    # codebook means new programs, dropped fleet-wide at this same
+    # stream position so no rank mixes codebooks within a cycle.
+    from ..ops import megakernel as _megakernel
+
+    _megakernel.flush(f"hvd-tune: dcn compression -> {value}")
+
+
+def _apply_max_inflight(st, value: int) -> None:
+    os.environ["HVD_TPU_MAX_INFLIGHT"] = str(value)
+    for window in list(_inflight_windows):
+        try:
+            window.resize(value)
+        except Exception:  # noqa: BLE001 — a dying step wrapper must
+            pass           # not wedge the drain tick
+
+
+def _apply_fusion_threshold(st, value: int) -> None:
+    st.fusion_threshold_bytes = int(value)
+    if st.coordinator is not None:
+        # Rank 0 / single-process: the facade invalidates memoized
+        # packing plans and flushes the megakernels itself.
+        st.coordinator.set_fusion_threshold(int(value))
+        from ..core import state as _state
+
+        for ps in _state.process_sets_snapshot():
+            if ps.coordinator is not None:
+                ps.coordinator.set_fusion_threshold(int(value))
+    else:
+        # Workers hold no coordinator but DO hold a cache replica with
+        # memoized packing plans and compiled megakernels.
+        if st.response_cache is not None:
+            st.response_cache.invalidate_plans(
+                f"hvd-tune: fusion threshold -> {value}")
+        from ..ops import megakernel as _megakernel
+
+        _megakernel.flush(f"hvd-tune: fusion threshold -> {value}")
+
+
+def _apply_cycle_time(st, value: float) -> None:
+    st.tick_seconds = float(value)
+
+
+def _apply_spec_tokens(st, value: int) -> None:
+    os.environ["HVD_TPU_SPEC_TOKENS"] = str(value)
+    for engine in list(_spec_engines):
+        try:
+            engine.set_spec_tokens(int(value))
+        except Exception:  # noqa: BLE001 — a draining engine must not
+            pass           # wedge the drain tick
+
+
+_APPLIERS = {
+    _policy.KNOB_DCN_COMPRESS: _apply_dcn_compress,
+    _policy.KNOB_MAX_INFLIGHT: _apply_max_inflight,
+    _policy.KNOB_FUSION_THRESHOLD: _apply_fusion_threshold,
+    _policy.KNOB_CYCLE_TIME: _apply_cycle_time,
+    _policy.KNOB_SPEC_TOKENS: _apply_spec_tokens,
+}
+
+
+# ---------------------------------------------------------------------------
+# Marker construction + apply (the response-stream surface)
+# ---------------------------------------------------------------------------
+
+def make_marker(tokens: List[str], seq: int) -> Response:
+    """A RETUNE stream marker: ``knob=value`` tokens + the decision
+    sequence number every rank logs on apply."""
+    return Response(ResponseType.RETUNE, tensor_names=list(tokens),
+                    tensor_sizes=[int(seq)])
+
+
+def apply_marker(resp: Response, st) -> None:
+    """Apply one RETUNE marker on THIS rank — called from the response
+    executor at the marker's stream position on every rank.  Malformed
+    tokens are skipped with a diagnostic (the drain tick must survive
+    anything the wire carries), applied tokens update the per-knob
+    gauges and the apply log line the np=2 coherence leg parses."""
+    seq = int(resp.tensor_sizes[0]) if resp.tensor_sizes else -1
+    applied: List[Tuple[str, object]] = []
+    for token in resp.tensor_names:
+        knob, _, raw = token.partition("=")
+        applier = _APPLIERS.get(knob)
+        if applier is None:
+            print(f"[hvd-tune] rank {st.process_index} skipping unknown "
+                  f"retune knob {token!r} (seq={seq})", file=sys.stderr)
+            continue
+        try:
+            value = _parse_value(knob, raw)
+            applier(st, value)
+        except (TypeError, ValueError) as e:
+            print(f"[hvd-tune] rank {st.process_index} skipping malformed "
+                  f"retune {token!r} (seq={seq}): {e}", file=sys.stderr)
+            continue
+        applied.append((knob, value))
+    if applied:
+        _M_APPLIED.inc(len(applied))
+        pairs = " ".join(f"{k}={v}" for k, v in applied)
+        print(f"[hvd-tune] rank {st.process_index} applied seq={seq} "
+              f"{pairs}", file=sys.stderr)
+    tuner = st.tuner
+    if tuner is not None:
+        tuner.note_applied(seq, applied)
+
+
+# ---------------------------------------------------------------------------
+# Fleet-coherence telemetry: the env-fingerprint digest gauge
+# ---------------------------------------------------------------------------
+
+def env_digest() -> int:
+    """Stable 53-bit integer digest of the SPMD env fingerprint
+    (ops/compression.env_fingerprint) — integers survive the JSON
+    metrics wire exactly, full float53 precision."""
+    from ..ops import compression as _compression
+
+    h = hashlib.sha256(_compression.env_fingerprint().encode()).digest()
+    return int.from_bytes(h[:7], "big") >> 3
+
+
+def _collect_tuning(reg) -> None:
+    """Every rank publishes its fingerprint digest + current knob values
+    (docs/metrics.md "hvd-tune"); the digest rides FRAME_METRICS pulls so
+    the rank-0 controller can verify a retune landed fleet-wide."""
+    reg.gauge("tuning.env_digest",
+              "53-bit digest of the SPMD env fingerprint").set(env_digest())
+    from ..core import state as _state
+
+    st = _state.global_state()
+    if not st.initialized:
+        return
+    knobs = current_knobs(st)
+    reg.gauge("tuning.knob.dcn_compress",
+              "DCN compression ladder rung (none/bf16/int8/int4)").set(
+        _policy.COMPRESSION_LADDER.index(knobs[_policy.KNOB_DCN_COMPRESS])
+        if knobs[_policy.KNOB_DCN_COMPRESS] in _policy.COMPRESSION_LADDER
+        else -1)
+    reg.gauge("tuning.knob.max_inflight",
+              "in-flight dispatch window depth").set(
+        knobs[_policy.KNOB_MAX_INFLIGHT])
+    reg.gauge("tuning.knob.fusion_threshold",
+              "tensor-fusion threshold bytes").set(
+        knobs[_policy.KNOB_FUSION_THRESHOLD])
+    reg.gauge("tuning.knob.cycle_time",
+              "background tick period seconds").set(
+        knobs[_policy.KNOB_CYCLE_TIME])
+    reg.gauge("tuning.knob.spec_tokens",
+              "speculative decode depth").set(
+        knobs[_policy.KNOB_SPEC_TOKENS])
+
+
+def install_collector() -> None:
+    """Idempotent (keyed) registration — every rank, every init."""
+    _telemetry.registry().register_collector("tuning", _collect_tuning)

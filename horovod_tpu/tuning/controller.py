@@ -1,0 +1,214 @@
+"""hvd-tune controller: the rank-0 closed loop (ROADMAP open item 3).
+
+One :class:`Tuner` lives on the process that owns negotiation — rank 0
+in multi-process mode, the only process otherwise — and is driven from
+the drain tick exactly like the round-4 autotuner it absorbs:
+``record_bytes`` per executed response, ``maybe_step`` per tick.  Every
+``HVD_TPU_TUNE_WINDOW`` ticks it samples the sensors
+(tuning/sensors.py), runs the pure policy engine (tuning/policy.py),
+and turns at most one decision into a RETUNE stream marker the next
+coordinator tick broadcasts (tuning/actuation.py) — so the controller
+itself never mutates a knob; it only ever *asks the stream to*, and its
+own rank applies at the same stream position as everyone else.
+
+Round-4 autotune fold-in: when ``HOROVOD_AUTOTUNE=1`` (kept as a
+deprecated alias of the subsystem) the explore-then-commit sweep
+(utils/autotune.py) runs as one rule inside this controller, its apply
+hook redirected onto the same marker path — and the sweep's two knobs
+(fusion_threshold, cycle_time) are pinned out of the rule table's
+reach, so two tuners can never fight over one knob.  ``done`` flips
+only once the commit marker has been APPLIED locally (not merely
+enqueued): callers that loop on ``autotuner.done`` observe the
+committed values the moment the loop exits.
+
+Fleet verification: after an applied retune in multi-process mode the
+next window pulls ``cluster_metrics()`` and compares every rank's
+``tuning.env_digest`` gauge; divergence (a rank that somehow missed the
+marker) increments ``tuning.rollbacks`` and enqueues a rollback marker
+restoring the previous values fleet-wide — a retune either completes on
+every rank or is rolled back on every rank, never a split-knob fleet.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from .. import telemetry as _telemetry
+from ..analysis import lockorder as _lockorder
+from ..ops.wire import Response
+from . import actuation as _actuation
+from . import policy as _policy
+
+_M_DECISIONS = _telemetry.counter(
+    "tuning.decisions", "policy decisions enqueued as RETUNE markers")
+_M_VETOES = _telemetry.counter(
+    "tuning.vetoes", "candidates vetoed by the planner's byte pricing")
+_M_ROLLBACKS = _telemetry.counter(
+    "tuning.rollbacks", "retunes rolled back after a fleet-coherence "
+                        "divergence")
+
+DEFAULT_WINDOW_TICKS = 64
+
+_SWEEP_KNOBS = (_policy.KNOB_FUSION_THRESHOLD, _policy.KNOB_CYCLE_TIME)
+
+
+def _pinned_from_env() -> frozenset:
+    raw = os.environ.get("HVD_TPU_TUNE_PIN", "")
+    return frozenset(p.strip() for p in raw.replace(";", ",").split(",")
+                     if p.strip())
+
+
+class Tuner:
+    def __init__(self, st, sweep: bool = False, closed_loop: bool = False,
+                 window_ticks: Optional[int] = None,
+                 policy_config: Optional[_policy.PolicyConfig] = None,
+                 verify_timeout: float = 2.0):
+        self._st = st
+        self._lock = _lockorder.make_lock("tuning.Tuner._lock")
+        self._pending: List[Response] = []  # guarded_by: _lock
+        self._next_seq = 0                  # guarded_by: _lock
+        self._applied_seq = -1
+        self._commit_seq: Optional[int] = None
+        self._verify_timeout = float(verify_timeout)
+        self._verify_due = False
+        # seq -> [(knob, previous value)] for rollback on divergence.
+        self._undo: Dict[int, List[Tuple[str, object]]] = {}
+        self._ticks = 0
+        self._window_ticks = int(
+            window_ticks if window_ticks is not None
+            else os.environ.get("HVD_TPU_TUNE_WINDOW",
+                                DEFAULT_WINDOW_TICKS))
+        self._sweep = None
+        self.policy: Optional[_policy.PolicyEngine] = None
+        self._sensors = None
+        self._vetoes_seen = 0
+        if closed_loop:
+            from ..memory.planner import retune_delta_bytes
+            from .sensors import WindowAggregator
+
+            cfg = policy_config
+            if cfg is None:
+                pinned = _pinned_from_env()
+                if sweep:
+                    # The fold-in's no-fighting rule: while the sweep
+                    # owns its two knobs the rule table cannot touch
+                    # them.
+                    pinned = pinned | frozenset(_SWEEP_KNOBS)
+                cfg = _policy.PolicyConfig(pinned=pinned)
+            self.policy = _policy.PolicyEngine(
+                cfg, price=lambda knob, old, new, snap:
+                retune_delta_bytes(knob, old, new, snap.knobs))
+            self._sensors = WindowAggregator(
+                st, straggler_skew_s=cfg.straggler_skew_us / 1e6)
+        if sweep:
+            from ..utils.autotune import Autotuner
+
+            self._sweep = Autotuner(self._enqueue_sweep)
+
+    # -- the autotune drain-loop contract ---------------------------------
+    def record_bytes(self, n: int) -> None:
+        if self._sweep is not None:
+            self._sweep.record_bytes(n)
+
+    @property
+    def committed(self):
+        return self._sweep.committed if self._sweep is not None else None
+
+    @property
+    def done(self) -> bool:
+        """The sweep is finished AND its commit has been applied locally
+        — loops waiting on ``done`` must observe the committed values."""
+        if self._sweep is None or self._sweep.committed is None:
+            return False
+        return self._commit_seq is not None \
+            and self._applied_seq >= self._commit_seq
+
+    def close(self) -> None:
+        if self._sweep is not None:
+            self._sweep.close()
+
+    # -- marker plumbing ---------------------------------------------------
+    def _enqueue(self, tokens: List[str],
+                 undo: Optional[List[Tuple[str, object]]] = None) -> int:
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            self._pending.append(_actuation.make_marker(tokens, seq))
+            if undo:
+                self._undo[seq] = list(undo)
+        return seq
+
+    def _enqueue_sweep(self, threshold: int, cycle: float) -> None:
+        """The Autotuner's apply hook, redirected onto the marker path."""
+        seq = self._enqueue([
+            f"{_policy.KNOB_FUSION_THRESHOLD}={int(threshold)}",
+            f"{_policy.KNOB_CYCLE_TIME}={float(cycle)}"])
+        if self._sweep is not None and self._sweep.committed is not None \
+                and self._commit_seq is None:
+            self._commit_seq = seq
+
+    def take_markers(self) -> List[Response]:
+        """Drain pending markers — called by the coordinator tick, which
+        appends them to the broadcast response stream."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        return pending
+
+    def note_applied(self, seq: int, applied) -> None:
+        """Actuation's callback once THIS rank applied a marker."""
+        if seq > self._applied_seq:
+            self._applied_seq = seq
+        if applied and self._st.multiprocess:
+            self._verify_due = True
+
+    # -- the closed loop ---------------------------------------------------
+    def maybe_step(self) -> None:
+        if self._sweep is not None:
+            self._sweep.maybe_step()
+        if self.policy is None:
+            return
+        self._ticks += 1
+        if self._ticks % self._window_ticks:
+            return
+        if self._verify_due:
+            self._verify_due = False
+            self._verify_fleet()
+        snap = self._sensors.sample()
+        decision = self.policy.step(snap)
+        if self.policy.vetoes > self._vetoes_seen:
+            _M_VETOES.inc(self.policy.vetoes - self._vetoes_seen)
+            self._vetoes_seen = self.policy.vetoes
+        if decision is None:
+            return
+        old = snap.knobs.get(decision.knob)
+        seq = self._enqueue([decision.wire()],
+                            undo=[(decision.knob, old)])
+        _M_DECISIONS.inc()
+        print(f"[hvd-tune] decision seq={seq} window={snap.index} "
+              f"{decision.wire()}: {decision.reason}", file=sys.stderr)
+
+    def _verify_fleet(self) -> None:
+        """Post-retune coherence check: every rank's env-digest gauge
+        must agree.  Divergence -> rollback marker, fleet-wide."""
+        if not self._st.multiprocess or self._st.transport is None:
+            return
+        try:
+            agg = _telemetry.cluster_metrics(timeout=self._verify_timeout)
+        except Exception:  # noqa: BLE001 — a mid-shutdown pull must not
+            return         # kill the drain tick; re-verified next window
+        per_rank = (agg.get("tuning.env_digest") or {}).get("per_rank")
+        if not per_rank or len(set(per_rank.values())) <= 1:
+            return
+        _M_ROLLBACKS.inc()
+        undo: List[Tuple[str, object]] = []
+        with self._lock:
+            for seq in sorted(self._undo, reverse=True):
+                undo.extend(self._undo.pop(seq))
+        ranks = sorted(per_rank)
+        print(f"[hvd-tune] env-digest divergence across ranks {ranks} "
+              f"after retune: rolling back {len(undo)} knob(s) "
+              f"fleet-wide", file=sys.stderr)
+        if undo:
+            self._enqueue([f"{k}={v}" for k, v in undo])

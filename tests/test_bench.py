@@ -38,10 +38,12 @@ def test_bench_control_mode_contract_and_speedup():
     """`--mode control` (round 6): the control-plane microbench emits
     one contract JSON line — no XLA, no tunnel, so it is fast enough
     for tier-1 — and the response cache must show a real speedup (the
-    CI job gates at 2x; this asserts a loaded-machine-safe floor)."""
+    CI job gates at 2x; this asserts a loaded-machine-safe floor —
+    a saturated single-core box has measured 1.26x in-suite against
+    ~5x quiet, so the floor stays below that)."""
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"),
-         "--mode", "control", "--control-seconds", "0.3"],
+         "--mode", "control", "--control-seconds", "0.5"],
         env=dict(os.environ), cwd=REPO, capture_output=True, timeout=120)
     assert proc.returncode == 0, proc.stderr.decode()[-2000:]
     lines = [ln for ln in proc.stdout.decode().splitlines()
@@ -53,7 +55,7 @@ def test_bench_control_mode_contract_and_speedup():
         assert key in payload, payload
     assert payload["metric"] == "control_plane_negotiations_per_sec"
     assert payload["cache_on"] > 0 and payload["cache_off"] > 0
-    assert payload["speedup"] >= 1.5, payload
+    assert payload["speedup"] >= 1.2, payload
     # hvd-telemetry overhead A/B rides the JSON (ISSUE 4 gate): both
     # rates present, the pct computed, and the counters attached.  The
     # ok-boolean itself is asserted by CI on a quiet box, not here — a
@@ -217,11 +219,14 @@ def test_bench_serving_mode_contract_and_determinism():
     (batch-composition invariance), and the engine rollout is bitwise-
     equal to the non-incremental forward.  The ≥ 1.5x tokens/sec gate
     lives in the CI `serving-bench` job; here only a loaded-box-safe
-    floor is asserted."""
+    floor is asserted.  Quick-size traces (the deterministic gates hold
+    at any trace size); the CI job runs the full trace."""
+    env = dict(os.environ)
+    env["HVD_TPU_BENCH_SERVING_QUICK"] = "1"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"),
          "--mode", "serving"],
-        env=dict(os.environ), cwd=REPO, capture_output=True, timeout=420)
+        env=env, cwd=REPO, capture_output=True, timeout=420)
     assert proc.returncode == 0, proc.stderr.decode()[-2000:]
     lines = [ln for ln in proc.stdout.decode().splitlines()
              if ln.strip().startswith("{")]
@@ -254,11 +259,15 @@ def test_bench_overlap_mode_contract_and_identity():
     in the CI `overlap-bench` job; wall-clock ratios under a concurrent
     tier-1 run are noise, so none is asserted here (the overlap win
     needs a real accelerator mesh — on the CPU mesh the two legs do the
-    same work on one shared thread pool)."""
+    same work on one shared thread pool).  Quick-size like the pipeline
+    test: the bitwise gates hold at any chain size and compile time
+    dominates the full-size run; the CI `overlap-bench` job runs full."""
+    env = dict(os.environ)
+    env["HVD_TPU_BENCH_OVERLAP_QUICK"] = "1"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"),
          "--mode", "overlap"],
-        env=dict(os.environ), cwd=REPO, capture_output=True, timeout=540)
+        env=env, cwd=REPO, capture_output=True, timeout=540)
     assert proc.returncode == 0, proc.stderr.decode()[-2000:]
     lines = [ln for ln in proc.stdout.decode().splitlines()
              if ln.strip().startswith("{")]

@@ -525,3 +525,44 @@ def test_handle_and_timeline_surface_cache_hits(tmp_path, monkeypatch):
                   if isinstance(e, dict) and isinstance(e.get("args"), dict)
                   and "cache" in e.get("args", {})}
     assert {"hit", "miss"} <= cache_args
+
+
+# ---------------------------------------------------------------------------
+# The _impl_dirty lost-wakeup regression (the roaming stall flake)
+# ---------------------------------------------------------------------------
+
+def test_drain_after_tick_races_mid_submit_still_sees_negotiation():
+    """Regression for the roaming single-process stall HorovodError: the
+    5 ms background tick landing BETWEEN a submit's dirty-flag update and
+    the impl-table insert must not hide the landed request behind the
+    cache fast path.  With the old flag-before-submit ordering the tick
+    cleared ``_impl_dirty`` and polled still-empty tables, so the one
+    explicit drain in ``synchronize`` short-circuited and raised
+    "it would stall".  The flag is now set after the impl call, so either
+    the racing tick polls the landed request or the flag survives for the
+    next drain."""
+    cache = ResponseCache(rank=0)
+    coord = Coordinator(size=1, fusion_threshold=THRESHOLD, cache=cache)
+    inner = coord._impl
+
+    class MidSubmitTick:
+        """Impl proxy firing one background drain tick at the exact
+        point the real race interleaves it: after the facade's submit
+        bookkeeping, before the request lands in the impl tables."""
+
+        def submit(self, req):
+            coord.poll_responses({})
+            return inner.submit(req)
+
+        def __getattr__(self, name):
+            return getattr(inner, name)
+
+    coord._impl = MidSubmitTick()
+    assert coord.submit(_req(0, "lostwakeup.t")) is True
+    # The single explicit drain that synchronize() performs: it must
+    # reach the impl (not the steady-state short circuit) and return
+    # the completed negotiation.
+    negotiated = coord.poll_responses({"lostwakeup.t": 16})
+    assert [r.response_type for r in negotiated] == [ResponseType.ALLREDUCE]
+    assert negotiated[0].tensor_names == ["lostwakeup.t"]
+    coord.close()
