@@ -2229,6 +2229,399 @@ def _tuning_bench(windows: int = 80) -> dict:
     }
 
 
+def _routing_bench(smoke: bool = False) -> dict:
+    """hvd-route fleet leg of ``--mode routing`` (pure Python, no jax,
+    no TPU tunnel).  Three legs over simulated replicas that speak the
+    client surface of routing/replica.py (health / generate / drain /
+    resume / prefixes — duck-typed where the HTTP client would sit):
+
+    1. **Trace replay** — a seeded million-request heavy-tailed trace
+       (Zipf-shared prompt headers, Pareto completion lengths, a
+       mid-trace arrival spike) against 6 single-server replica queues
+       with LRU prefix caches keyed by the REAL chain hashes
+       (routing/affinity.py).  Least-loaded + prefix-affinity dispatch
+       vs round-robin: p99 TTFT and aggregate tokens/sec gates, plus a
+       bit-identical placement digest on replay (the scorer is free of
+       wall clock and PRNG).
+    2. **Failover digest identity** — the REAL Router dispatches over
+       replicas whose completions are a pure rolling-hash function of
+       the tokens so far (the sim analogue of the serving bitwise
+       contract: prompt+partial reproduces the uninterrupted tail).
+       One replica drains mid-generation (503 with partial tokens),
+       another dies outright (connection severed, no partials); every
+       merged completion must be digest-identical to a single-replica
+       reference run.
+    3. **Autoscaling** — the REAL FleetAutoscaler over the REAL
+       Router: a sustained spike boots a replica (priced by the
+       hvd-mem planner against host headroom, prefix-seeded from the
+       busiest donor), a second spike against exhausted headroom is
+       VETOED (never an OOM), and the trough drains the booted replica
+       back, donating its prefix index to a survivor.
+    """
+    import hashlib
+    import random as _random
+    from collections import OrderedDict
+
+    from horovod_tpu.memory.planner import (kv_cache_bytes,
+                                            prefix_pages_bytes)
+    from horovod_tpu.routing import (AutoscaleConfig, FleetAutoscaler,
+                                     Router, RouterConfig)
+    from horovod_tpu.routing.affinity import (prompt_header_hashes,
+                                              published_page_hashes)
+    from horovod_tpu.routing.replica import ReplicaUnreachable
+
+    PAGE, PPS = 16, 8
+    FP = "routing-bench-fp"
+
+    # ---- leg 1: million-request heavy-tailed trace replay ----------------
+    n_requests = 20_000 if smoke else 1_000_000
+    n_replicas = 6
+    n_headers = 400
+    header_tokens = 4 * PAGE      # 4-page shared prompt headers
+    cache_cap = 64                # headers one replica keeps warm (LRU)
+    prefill_us = 60.0             # cost per uncached prompt token
+    decode_us = 50.0              # cost per generated token
+    rng = _random.Random(20)
+
+    headers = [[rng.randrange(256) for _ in range(header_tokens)]
+               for _ in range(n_headers)]
+    # One chain hash per header, computed ONCE through the real scheme
+    # (routing/affinity.py) — the first-page digest stands for the
+    # whole chain in the sim's per-replica index.
+    header_key = [prompt_header_hashes(FP.encode(), h + [0], PAGE,
+                                       PPS)[0] for h in headers]
+    weights = [1.0 / (r + 1) ** 0.7 for r in range(n_headers)]
+    cum, acc = [], 0.0
+    for w in weights:
+        acc += w
+        cum.append(acc)
+    hdr = rng.choices(range(n_headers), cum_weights=cum, k=n_requests)
+    suffix = [rng.randrange(8, 25) for _ in range(n_requests)]
+    mtok = [max(1, min(64, int(4 * rng.paretovariate(1.5))))
+            for _ in range(n_requests)]
+    # Arrivals: Poisson at a base rate with a 1.25x spike through the
+    # middle third (the autoscaling leg re-uses the same shape).
+    base_us = 1e6 / 2400.0
+    arrive, t = [], 0.0
+    lo, hi = n_requests // 3, 2 * n_requests // 3
+    for i in range(n_requests):
+        mean = base_us / 1.25 if lo <= i < hi else base_us
+        t += rng.expovariate(1.0 / mean)
+        arrive.append(t)
+
+    def _replay(policy: str) -> dict:
+        busy = [0.0] * n_replicas
+        caches = [OrderedDict() for _ in range(n_replicas)]
+        hits, total_tokens = 0, 0
+        ttfts = []
+        placements = hashlib.sha256()
+        aff_bonus = header_tokens * prefill_us  # prefill saved by a hit
+        for i in range(n_requests):
+            now = arrive[i]
+            key = header_key[hdr[i]]
+            if policy == "rr":
+                r = i % n_replicas
+            else:
+                best = None
+                for j in range(n_replicas):
+                    backlog = busy[j] - now
+                    if backlog < 0.0:
+                        backlog = 0.0
+                    score = backlog
+                    if key in caches[j]:
+                        score -= aff_bonus
+                    if best is None or score < best[0]:
+                        best = (score, j)
+                r = best[1]
+            cache = caches[r]
+            if key in cache:
+                hits += 1
+                cache.move_to_end(key)
+                prefill = suffix[i] * prefill_us
+            else:
+                cache[key] = None
+                if len(cache) > cache_cap:
+                    cache.popitem(last=False)
+                prefill = (header_tokens + suffix[i]) * prefill_us
+            start = busy[r] if busy[r] > now else now
+            ttfts.append(start + prefill - now)
+            busy[r] = start + prefill + mtok[i] * decode_us
+            total_tokens += mtok[i]
+            placements.update(bytes([r]))
+        ttfts.sort()
+        makespan_s = max(busy) / 1e6
+        return {
+            "p50_ttft_ms": round(ttfts[len(ttfts) // 2] / 1e3, 3),
+            "p99_ttft_ms": round(
+                ttfts[int(0.99 * (len(ttfts) - 1))] / 1e3, 3),
+            "tokens_per_sec": round(total_tokens / makespan_s, 1),
+            "affinity_hit_rate": round(hits / n_requests, 4),
+            "placement_digest": placements.hexdigest()[:16],
+        }
+
+    rr = _replay("rr")
+    aff = _replay("affinity")
+    aff_replay = _replay("affinity")
+
+    # ---- shared sim replica for the real-Router legs ---------------------
+    VOCAB = 251
+
+    def _fold(state: int, tok: int) -> int:
+        return (state * 1103515245 + tok + 12345) & 0x7FFFFFFF
+
+    def _complete(prompt, m):
+        # State is a pure fold over the tokens SO FAR, so
+        # _complete(prompt + partial, m - k) == _complete(prompt, m)[k:]
+        # — the sim analogue of the serving bitwise contract that makes
+        # drain continuations digest-exact.
+        s = 0
+        for tok in prompt:
+            s = _fold(s, int(tok))
+        out = []
+        for _ in range(m):
+            tok = (s * 48271 + 11) % VOCAB
+            out.append(tok)
+            s = _fold(s, tok)
+        return out
+
+    class _SimReplica:
+        def __init__(self, name: str) -> None:
+            self.name = name
+            self.ready = True
+            self.dead = False
+            self.queue_depth = 0  # external load knob (autoscale leg)
+            self.pending = 0      # decaying backlog of recent serves
+            self.served = 0
+            self.drain_at = None   # served count: 503 mid-generation
+            self.die_at = None     # served count: connection severed
+            self.index = OrderedDict()  # published chain-hash digests
+            self.chains = []            # published token chains
+            self.resumes = []           # payloads received via resume()
+
+        def _publish(self, toks) -> None:
+            self.chains.append(list(toks))
+            for h in published_page_hashes(FP.encode(), toks, PAGE,
+                                           PPS):
+                self.index[h] = None
+
+        def health(self):
+            if self.dead:
+                raise ReplicaUnreachable(f"{self.name} is down")
+            det = {"ready": self.ready,
+                   "queue_depth": self.queue_depth + self.pending,
+                   "kv_free_pages": 1 << 20,
+                   "kv_total_pages": 1 << 20,
+                   "page_size": PAGE, "pages_per_slot": PPS,
+                   "fingerprint": FP,
+                   "prefix_index": list(self.index)[-512:]}
+            # Each poll "works off" part of the backlog, so the
+            # reported depth tracks recent assignment — without it
+            # every score ties at zero and the name tie-break funnels
+            # the whole fleet's traffic onto one replica.
+            self.pending = max(0, self.pending - 8)
+            return (200 if self.ready else 503), {"serving": det}
+
+        def generate(self, payload, timeout=None):
+            if self.dead:
+                raise ReplicaUnreachable(f"{self.name} is down")
+            if not self.ready:
+                return 503, {"error": "draining", "tokens": []}
+            self.served += 1
+            self.pending += 1
+            prompt = [int(tok) for tok in payload["tokens"]]
+            m = int(payload.get("max_tokens", 32))
+            if self.served == self.die_at:
+                self.dead = True
+                raise ReplicaUnreachable(f"{self.name} died mid-call")
+            if self.served == self.drain_at:
+                emitted = _complete(prompt, max(1, m // 2))
+                self.ready = False
+                return 503, {"error": "drained", "tokens": emitted}
+            toks = _complete(prompt, m)
+            self._publish(prompt + toks)
+            return 200, {"tokens": toks, "finish_reason": "length"}
+
+        def drain(self):
+            if self.dead:
+                raise ReplicaUnreachable(f"{self.name} is down")
+            self.ready = False
+            return 200, {"requests": [],
+                         "prefixes": [list(c) for c in self.chains]}
+
+        def prefixes(self):
+            if self.dead:
+                raise ReplicaUnreachable(f"{self.name} is down")
+            return 200, {"prefixes": [list(c) for c in self.chains]}
+
+        def resume(self, payload):
+            if self.dead:
+                raise ReplicaUnreachable(f"{self.name} is down")
+            self.resumes.append(payload)
+            for chain in payload.get("prefixes") or []:
+                self._publish([int(tok) for tok in chain])
+            self.ready = True
+            return 200, {"installed":
+                         len(payload.get("requests") or []),
+                         "ready": True}
+
+    # ---- leg 2: drain/death failover, digest-identical completions -------
+    def _failover_leg() -> dict:
+        lrng = _random.Random(7)
+        reqs = []
+        for _ in range(240):
+            prompt = (headers[lrng.randrange(40)]
+                      + [lrng.randrange(256)
+                         for _ in range(lrng.randrange(4, 12))])
+            reqs.append((prompt, 8 + lrng.randrange(24)))
+
+        def _digest(runs) -> str:
+            d = hashlib.sha256()
+            for prompt, toks in runs:
+                d.update(f"{len(prompt)}:".encode())
+                d.update(",".join(str(int(tok))
+                                  for tok in toks).encode())
+            return d.hexdigest()
+
+        reference = _digest((p, _complete(p, m)) for p, m in reqs)
+
+        router = Router(RouterConfig(probe_base=0.0),
+                        sleep=lambda s: None)
+        fleet = [_SimReplica(f"r{j}") for j in range(4)]
+        fleet[1].drain_at = 25  # drains mid-generation (503+partials)
+        fleet[2].die_at = 40    # severed mid-call, no partials
+        for rep in fleet:
+            router.add_replica(rep.name, rep)
+        router.poll()
+        runs, continuations, failovers, aff_requests = [], 0, 0, 0
+        for k, (prompt, m) in enumerate(reqs):
+            if k % 16 == 0:
+                router.poll()
+            status, resp = router.dispatch({"tokens": prompt,
+                                            "max_tokens": m})
+            if status != 200:
+                return {"requests": len(reqs),
+                        "digest_identical": False,
+                        "error": f"dispatch {status}: {resp}"}
+            runs.append((prompt, resp["tokens"]))
+            stamp = resp.get("router") or {}
+            continuations += int(stamp.get("resubmits", 0))
+            failovers += int(stamp.get("failovers", 0))
+            if int(stamp.get("affinity_pages", 0)) > 0:
+                aff_requests += 1
+        return {"requests": len(reqs),
+                "digest_identical": _digest(runs) == reference,
+                "continuations": continuations,
+                "failovers": failovers,
+                "affinity_requests": aff_requests}
+
+    # ---- leg 3: autoscaling with planner pricing -------------------------
+    def _autoscale_leg() -> dict:
+        router = Router(RouterConfig(probe_base=0.0),
+                        sleep=lambda s: None)
+        pool = {}
+
+        def launch(name: str):
+            rep = _SimReplica(name)
+            pool[name] = rep
+            return rep
+
+        def retire(name: str) -> None:
+            pool.pop(name, None)
+
+        base = [_SimReplica(f"base{j}") for j in range(2)]
+        for rep in base:
+            pool[rep.name] = rep
+            router.add_replica(rep.name, rep)
+        # Warm the donor so scale-up has live prefixes to seed from.
+        base[0].resume({"requests": [],
+                        "prefixes": [headers[j] + [1]
+                                     for j in range(8)]})
+        router.poll()
+
+        # hvd-mem pricing: one replica's serving footprint (KV pool +
+        # prefix reserve) against a shrinking host-headroom ledger.
+        replica_bytes = (kv_cache_bytes(4, 8, 64, 8, PPS, PAGE)
+                         + prefix_pages_bytes(4, 8, 64, 64, PAGE))
+        host = {"free": replica_bytes + replica_bytes // 2}
+        scaler = FleetAutoscaler(
+            router, launch, retire,
+            AutoscaleConfig(min_replicas=2, max_replicas=4,
+                            up_load=4.0, down_load=1.0, sustain=2,
+                            cooldown=1),
+            price=lambda: replica_bytes,
+            headroom=lambda: host["free"])
+
+        events, seeded_pages, oom_free = [], 0, True
+
+        def tick() -> None:
+            nonlocal seeded_pages, oom_free
+            router.poll()
+            e = scaler.observe()
+            if e is None:
+                return
+            events.append(e)
+            if e.startswith("up:"):
+                host["free"] -= replica_bytes
+                if host["free"] < 0:  # a boot the planner should have
+                    oom_free = False  # vetoed landed on an OOM
+                newcomer = pool.get(e.split(":", 1)[1])
+                if newcomer is not None:
+                    seeded_pages = max(seeded_pages,
+                                       len(newcomer.index))
+            elif e.startswith("down:"):
+                host["free"] += replica_bytes
+
+        # Spike: deep queues everywhere -> scale up (priced, seeded).
+        for rep in pool.values():
+            rep.queue_depth = 9
+        for _ in range(4):
+            tick()
+        # Still spiking, headroom now exhausted -> veto, never a boot.
+        for rep in pool.values():
+            rep.queue_depth = 9
+        for _ in range(4):
+            tick()
+        # Trough: fleet idles -> drain the booted replica back.
+        for rep in pool.values():
+            rep.queue_depth = 0
+        for _ in range(4):
+            tick()
+
+        donated = any(rep.resumes for rep in base)
+        return {"events": events,
+                "scaled_up": any(e.startswith("up:") for e in events),
+                "seeded_pages": seeded_pages,
+                "veto": "veto:up" in events,
+                "scaled_down": any(e.startswith("down:")
+                                   for e in events),
+                "prefixes_donated": donated,
+                "fleet_final": router.replica_names(),
+                "oom_free": oom_free and host["free"] >= 0}
+
+    failover = _failover_leg()
+    autoscale = _autoscale_leg()
+    return {
+        "metric": "routing_tokens_per_sec",
+        "value": aff["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": round(aff["tokens_per_sec"]
+                             / rr["tokens_per_sec"], 2)
+        if rr["tokens_per_sec"] else None,
+        "n_requests": n_requests,
+        "n_replicas": n_replicas,
+        "round_robin": rr,
+        "affinity": aff,
+        "p99_ttft_speedup": round(rr["p99_ttft_ms"]
+                                  / aff["p99_ttft_ms"], 2),
+        "tokens_per_sec_speedup": round(aff["tokens_per_sec"]
+                                        / rr["tokens_per_sec"], 2),
+        "affinity_hit_rate": aff["affinity_hit_rate"],
+        "deterministic_replay": aff == aff_replay,
+        "failover": failover,
+        "autoscale": autoscale,
+    }
+
+
 def _probe_inner() -> int:
     """Tunnel probe child: one tiny jitted matmul with a host fetch.
 
@@ -2295,7 +2688,7 @@ def main() -> int:
     ap.add_argument("--mode",
                     choices=["resnet", "control", "dataplane", "input",
                              "serving", "overlap", "pipeline",
-                             "memory", "fused", "tuning"],
+                             "memory", "fused", "tuning", "routing"],
                     default="resnet",
                     help="control = control-plane negotiations/sec only "
                          "(no XLA, no TPU tunnel); dataplane = "
@@ -2328,6 +2721,12 @@ def main() -> int:
                          "tuning = hvd-tune closed-loop convergence — "
                          "the real policy engine + hvd-mem pricing "
                          "over a deterministic mis-tuned fleet model "
+                         "(no XLA, no TPU tunnel); routing = hvd-route "
+                         "fleet dispatch — least-loaded + prefix-"
+                         "affinity vs round-robin on a seeded million-"
+                         "request heavy-tailed trace, drain/death "
+                         "failover digest identity through the real "
+                         "Router, and planner-priced autoscaling "
                          "(no XLA, no TPU tunnel)")
     ap.add_argument("--check-speedup", type=float, default=None,
                     help="control mode: exit nonzero when the cache-on/"
@@ -2612,6 +3011,63 @@ def main() -> int:
             if not result.get("deterministic_replay"):
                 failures.append("decision sequence not identical on "
                                 "replay")
+            if failures:
+                for f in failures:
+                    print(f"FAIL: {f}", file=sys.stderr)
+                return 1
+        return 0
+
+    if args.mode == "routing":
+        # Pure Python (router + autoscaler + queueing sim): no XLA, no
+        # mesh, no tunnel.
+        result = _routing_bench(smoke=args.smoke)
+        print(json.dumps(result))
+        if args.check_speedup is not None:
+            failures = []
+            if (result.get("p99_ttft_speedup")
+                    or 0.0) < args.check_speedup:
+                failures.append(
+                    f"p99 TTFT speedup {result.get('p99_ttft_speedup')}"
+                    f"x over round-robin < required "
+                    f"{args.check_speedup}x")
+            if (result.get("tokens_per_sec_speedup")
+                    or 0.0) < args.check_speedup:
+                failures.append(
+                    f"tokens/sec speedup "
+                    f"{result.get('tokens_per_sec_speedup')}x over "
+                    f"round-robin < required {args.check_speedup}x")
+            if (result.get("affinity_hit_rate") or 0.0) <= 0.0:
+                failures.append("affinity hit rate is zero — the "
+                                "prefix index never routed a warm "
+                                "header")
+            if not result.get("deterministic_replay"):
+                failures.append("placement sequence not identical on "
+                                "replay")
+            fo = result.get("failover") or {}
+            if not fo.get("digest_identical"):
+                failures.append(
+                    "failover completions are not digest-identical to "
+                    f"the single-replica reference ({fo.get('error')})")
+            if (fo.get("continuations") or 0) < 1:
+                failures.append("no drain continuation was exercised")
+            if (fo.get("failovers") or 0) < 2:
+                failures.append("drain+death failovers not exercised")
+            auto = result.get("autoscale") or {}
+            for gate, msg in (
+                    ("scaled_up", "the spike never booted a replica"),
+                    ("seeded_pages", "the booted replica was not "
+                                     "prefix-seeded from a donor"),
+                    ("veto", "the exhausted-headroom boot was not "
+                             "vetoed by the planner price check"),
+                    ("scaled_down", "the trough never drained a "
+                                    "replica back"),
+                    ("prefixes_donated", "the drained replica's "
+                                         "prefix index was not "
+                                         "donated to a survivor"),
+                    ("oom_free", "a scale-up landed on an OOM")):
+                if not auto.get(gate):
+                    failures.append(f"autoscale: {msg} "
+                                    f"(events={auto.get('events')})")
             if failures:
                 for f in failures:
                     print(f"FAIL: {f}", file=sys.stderr)

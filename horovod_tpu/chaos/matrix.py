@@ -208,6 +208,27 @@ SCENARIOS: List[Scenario] = [
             "target AND draft KV slots and decrements the prefix "
             "refcounts; the follow-up request (a prefix-cache hit) "
             "completes identically to the fault-free run"),
+    # -- hvd-route fleet router (routing/router.py) ----------------------
+    Scenario(
+        "router_replica_death", "local", "recover", cap=300.0,
+        spec="router.replica_kill:count=1@40",
+        needle="failed over",
+        doc="two real replicas behind the real Router over real HTTP; "
+            "the one that served the first request is drained and "
+            "then killed hard — dispatch fails over to the survivor "
+            "and every completion is identical to the fault-free "
+            "fleet's"),
+    Scenario(
+        "router_restart", "local", "recover", cap=300.0,
+        spec="router.kill:count=1@41",
+        needle="severed router connection",
+        doc="the real RouterServer runs as a separate PROCESS and is "
+            "SIGKILLed mid-generation; the replicas abort the severed "
+            "sockets via the client probe (no slot leak), a fresh "
+            "router over the same fleet serves the resubmitted "
+            "request, and completions are identical to the never-"
+            "killed run (the bitwise contract makes the retry the "
+            "same answer)"),
 ]
 
 
@@ -775,6 +796,224 @@ def scenario_serving_storm() -> None:
         srv.close()
 
 
+def scenario_router_replica_death() -> None:
+    """Two real replicas (identical params, so completions are
+    bitwise-identical wherever they run) behind the REAL Router over
+    real HTTP.  The faulted pass drains the replica that served the
+    first request and then kills its front door hard: the remaining
+    dispatches must fail over to the survivor, and the digested
+    completions must match the fault-free fleet's exactly."""
+    import jax
+
+    from .. import chaos as _chaos
+    from ..models.transformer import TransformerConfig, init_transformer
+    from ..routing import Router, RouterConfig
+    from ..routing.replica import HttpReplicaClient
+    from ..serving.engine import InferenceEngine
+    from ..serving.server import LMServer
+
+    from ..telemetry import exporter as _exporter
+
+    cfg = TransformerConfig(vocab_size=256, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64)
+    params = init_transformer(jax.random.PRNGKey(5), cfg)
+
+    def replica():
+        engine = InferenceEngine(params, cfg, max_slots=2, page_size=8,
+                                 capacity=64, prefix_cache=True)
+        # Private routes: two replicas in one process must not clobber
+        # each other's /generate + /healthz (LMServer docstring).
+        return LMServer(engine, port=0,
+                        routes=_exporter.RouteRegistry()).start()
+
+    servers = {"a": replica(), "b": replica()}
+    router = Router(RouterConfig(probe_base=0.01))
+    try:
+        for name, srv in servers.items():
+            router.add_replica(
+                name, HttpReplicaClient("127.0.0.1", srv.port))
+        router.poll()
+        records = []
+        header = list(range(40, 56))  # two full 8-token pages
+        status, first = router.dispatch(
+            {"tokens": header + [5, 6, 7], "max_tokens": 12})
+        if status != 200:
+            _diag(0, f"first dispatch failed: {status} {first}")
+        records.append(("req0", tuple(first["tokens"]),
+                        first["finish_reason"]))
+        if _chaos.fire("router.replica_kill") is not None:
+            victim = first["router"]["replica"]
+            router.drain_replica(victim)  # real POST /drain
+            servers[victim].close()       # then the hard death
+            router.poll()                 # -> ReplicaUnreachable
+        for i, prompt in enumerate((header + [9, 10, 11],
+                                    [7, 8, 9, 10])):
+            status, resp = router.dispatch({"tokens": prompt,
+                                            "max_tokens": 8})
+            if status != 200:
+                _diag(0, f"dispatch {i + 1} failed after the replica "
+                         f"death: {status} {resp}")
+            records.append((f"req{i + 1}", tuple(resp["tokens"]),
+                            resp["finish_reason"]))
+        down = sorted(n for n, s in router.replica_status().items()
+                      if s["status"] != "ready")
+        if _chaos.active():
+            if not down:
+                _diag(0, "the kill was injected but every replica "
+                         "still reads ready")
+            print(f"[hvd-route] failed over from {down} to the "
+                  f"surviving replica", flush=True)
+        _result(0, records)
+    finally:
+        for srv in servers.values():
+            try:
+                srv.close()
+            except Exception:  # noqa: BLE001 — the victim is already
+                pass           # closed on the faulted pass
+
+
+def scenario_router_restart() -> None:
+    """The REAL RouterServer runs in a SEPARATE process over two real
+    in-process replicas; the faulted pass SIGKILLs it mid-generation.
+    The replicas must abort the severed connections via the client
+    probe (no slot leak), a fresh router over the same fleet serves
+    the resubmitted request, and the digested completions are
+    identical to the never-killed run (the serving bitwise contract
+    makes the retry the same answer)."""
+    import signal
+    import threading
+    import urllib.request
+
+    import jax
+
+    from .. import chaos as _chaos
+    from .. import telemetry as _telemetry
+    from ..models.transformer import TransformerConfig, init_transformer
+    from ..serving.engine import InferenceEngine
+    from ..serving.server import LMServer
+
+    from ..telemetry import exporter as _exporter
+
+    # Wide enough that a 220-token generation takes whole seconds on
+    # CPU — the SIGKILL below must land mid-generation.
+    cfg = TransformerConfig(vocab_size=256, d_model=64, n_heads=2,
+                            n_layers=2, d_ff=256, max_seq_len=256)
+    params = init_transformer(jax.random.PRNGKey(5), cfg)
+
+    def replica():
+        engine = InferenceEngine(params, cfg, max_slots=2, page_size=8,
+                                 capacity=256)
+        return LMServer(engine, port=0,
+                        routes=_exporter.RouteRegistry()).start()
+
+    servers = [replica(), replica()]
+
+    def boot_router():
+        port = _free_port()
+        env = dict(os.environ)
+        env.pop("HVD_TPU_FAULTS", None)  # the router child is plain
+        env["HVD_TPU_CHAOS_REPLICAS"] = ",".join(
+            str(s.port) for s in servers)
+        env["HVD_TPU_CHAOS_ROUTER_PORT"] = str(port)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.chaos",
+             "--scenario", "router_restart_node"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                _diag(0, f"router child exited {proc.returncode} "
+                         f"before becoming healthy")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=2.0) as resp:
+                    if resp.status == 200:
+                        return proc, port
+            except Exception:  # noqa: BLE001 — still booting
+                time.sleep(0.1)
+        proc.kill()
+        _diag(0, "router child never became healthy")
+
+    proc, port = boot_router()
+    try:
+        records = []
+        r0 = _post_generate(port, {"tokens": [5, 6, 7],
+                                   "max_tokens": 8})
+        records.append(("req0", tuple(r0["tokens"]),
+                        r0["finish_reason"]))
+        long_payload = {"tokens": [11, 12, 13, 14], "max_tokens": 220}
+        if _chaos.fire("router.kill") is not None:
+            severed: Dict[str, object] = {}
+
+            def fire_and_forget() -> None:
+                try:
+                    severed["resp"] = _post_generate(
+                        port, long_payload, timeout=120.0)
+                except Exception as e:  # noqa: BLE001 — the router
+                    severed["error"] = str(e)  # we kill takes it down
+            th = threading.Thread(target=fire_and_forget, daemon=True)
+            th.start()
+            time.sleep(0.15)  # let the replica start decoding
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+            th.join(timeout=30.0)
+            proc, port = boot_router()
+            aborted = 0
+            deadline = time.monotonic() + 45.0
+            while time.monotonic() < deadline:
+                snap = _telemetry.metrics()
+                aborted = snap.get("serving.client_disconnects",
+                                   {}).get("value", 0)
+                if aborted >= 1:
+                    break
+                time.sleep(0.2)
+            if aborted < 1:
+                _diag(0, f"router killed mid-generation but no "
+                         f"replica aborted the orphaned request "
+                         f"(client_disconnects={aborted}; severed "
+                         f"reply: {severed})")
+            print(f"[hvd-route] replica aborted the severed router "
+                  f"connection (client_disconnects={aborted}); "
+                  f"resubmitting through the restarted router",
+                  flush=True)
+        rl = _post_generate(port, long_payload, timeout=120.0)
+        records.append(("long", tuple(rl["tokens"]),
+                        rl["finish_reason"]))
+        r2 = _post_generate(port, {"tokens": [9, 10, 11],
+                                   "max_tokens": 8})
+        records.append(("req2", tuple(r2["tokens"]),
+                        r2["finish_reason"]))
+        _result(0, records)
+    finally:
+        proc.kill()
+        proc.wait()
+        for srv in servers:
+            srv.close()
+
+
+def _router_restart_node() -> None:
+    """(child helper, no matrix row) The router process of
+    ``router_restart``: the REAL RouterServer over HTTP clients to the
+    parent scenario's replicas; the parent SIGKILLs it mid-generation
+    on the faulted pass."""
+    from ..routing import Router, RouterConfig, RouterServer
+    from ..routing.replica import HttpReplicaClient
+
+    ports = [int(p) for p in
+             os.environ["HVD_TPU_CHAOS_REPLICAS"].split(",")]
+    router = Router(RouterConfig(probe_base=0.01))
+    for i, port in enumerate(ports):
+        router.add_replica(f"r{i}",
+                           HttpReplicaClient("127.0.0.1", port))
+    RouterServer(
+        router, port=int(os.environ["HVD_TPU_CHAOS_ROUTER_PORT"]),
+        poll_interval=0.2).start()
+    while True:  # serve until the parent kills us
+        time.sleep(60.0)
+
+
 LOCAL_SCENARIOS = {
     "ckpt_flaky": lambda: scenario_ckpt(exhaust=False),
     "ckpt_exhaustion": lambda: scenario_ckpt(exhaust=True),
@@ -782,6 +1021,9 @@ LOCAL_SCENARIOS = {
     "serving_disconnect": scenario_serving_disconnect,
     "serving_spec_disconnect": scenario_serving_spec_disconnect,
     "serving_storm": scenario_serving_storm,
+    "router_replica_death": scenario_router_replica_death,
+    "router_restart": scenario_router_restart,
+    "router_restart_node": _router_restart_node,
 }
 
 

@@ -73,6 +73,8 @@ VALID_SITES = (
     "ckpt.oserror",
     "input.stall",
     "serving.disconnect",
+    "router.replica_kill",
+    "router.kill",
 )
 
 _DEFAULT_DELAY = 0.05

@@ -339,6 +339,41 @@ class TrainerState(State):
         self._install()
 
 
+def serving_export_payload(engine: Any,
+                           exported: Optional[List[dict]] = None
+                           ) -> dict:
+    """The serving migration payload: requests (queued + in-flight as
+    continuations) plus the shared-prefix index as maximal token
+    chains.  ``exported`` short-circuits the request export when the
+    caller already drained the engine (the export must come from THAT
+    drain — a second ``export_requests`` after it would be empty).
+    Shared by :class:`ServingState`'s commit blob and the replica-side
+    ``POST /drain`` hook the hvd-route tier scales down through."""
+    if exported is None:
+        exported = engine.export_requests()
+    export = getattr(engine, "export_prefix_index", None)
+    return {"requests": exported,
+            "prefixes": export() if export is not None else []}
+
+
+def serving_install_payload(engine: Any, payload: Any) -> List[dict]:
+    """Install a :func:`serving_export_payload` dict into an engine:
+    drain whatever it holds (retry path: the committed set replaces it
+    wholesale), ghost-seed the shared-prefix chains (cheap, and the
+    resubmitted continuations below already hit them), then resubmit.
+    Accepts the pre-prefix-cache blob format (a bare request list).
+    Returns the requests installed."""
+    if isinstance(payload, list):  # pre-prefix-cache blob format
+        payload = {"requests": payload, "prefixes": []}
+    engine.drain()
+    seed = getattr(engine, "seed_prefixes", None)
+    if seed is not None and payload.get("prefixes"):
+        seed(payload["prefixes"])
+    requests = payload.get("requests", [])
+    engine.import_requests(requests)
+    return requests
+
+
 class ServingState(State):
     """Elastic state for a serving fleet
     (:class:`horovod_tpu.serving.engine.InferenceEngine`) — the resize
@@ -379,16 +414,9 @@ class ServingState(State):
     def _blob(self, exported: Optional[List[dict]] = None) -> Any:
         import json
 
-        if exported is None:
-            exported = self._engine.export_requests()
-        payload = {"requests": exported,
-                   "prefixes": self._export_prefixes()}
+        payload = serving_export_payload(self._engine, exported)
         return np.frombuffer(json.dumps(payload).encode(),
                              np.uint8).copy()
-
-    def _export_prefixes(self) -> List[List[int]]:
-        export = getattr(self._engine, "export_prefix_index", None)
-        return export() if export is not None else []
 
     def _capture(self) -> None:
         self._values["requests_blob"] = self._blob()
@@ -397,18 +425,8 @@ class ServingState(State):
         import json
 
         blob = bytes(np.asarray(self._values["requests_blob"]))
-        payload = json.loads(blob.decode() or "[]")
-        if isinstance(payload, list):  # pre-prefix-cache blob format
-            payload = {"requests": payload, "prefixes": []}
-        # Clear whatever the engine currently holds (retry path: the
-        # committed set replaces it wholesale), seed the shared-prefix
-        # pages (ghost prefills — cheap, and the resubmitted
-        # continuations below already hit them), then resubmit.
-        self._engine.drain()
-        seed = getattr(self._engine, "seed_prefixes", None)
-        if seed is not None and payload.get("prefixes"):
-            seed(payload["prefixes"])
-        self._engine.import_requests(payload.get("requests", []))
+        serving_install_payload(self._engine,
+                                json.loads(blob.decode() or "[]"))
 
     def commit(self) -> None:
         self._capture()

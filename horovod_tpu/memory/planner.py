@@ -177,6 +177,18 @@ def retune_delta_bytes(knob: str, old, new, knobs) -> int:
         except (TypeError, ValueError):
             per_token = 0
         return (new_i - old_i) * per_token
+    if knob == "prefix_pages":
+        # Growing the shared-prefix reserve pins extra KV pages; the
+        # per-page byte cost comes from the live cache
+        # (``page_global_bytes``, advertised as ``prefix_page_bytes``
+        # by tuning.actuation.current_knobs), the SAME byte model
+        # prefix_pages_bytes prices at plan time — unpriceable (0)
+        # without a live serving engine.
+        try:
+            per_page = int(knobs.get("prefix_page_bytes", 0) or 0)
+        except (TypeError, ValueError):
+            per_page = 0
+        return (new_i - old_i) * per_page
     # Compression escalation narrows wire bytes and cycle_time is
     # host-side only — neither ever costs device memory.
     return 0

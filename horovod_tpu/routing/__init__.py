@@ -1,0 +1,29 @@
+"""hvd-route: the pure-Python router tier over N serving replicas.
+
+Least-loaded + prefix-affinity dispatch, drain-aware failover, and
+fleet autoscaling — all over the HTTP contract the serving tier
+already exports (``/healthz``, ``/generate``, and the fleet hooks
+``/drain``/``/resume``/``/prefixes``).  No jax anywhere in this
+package: like the scheduler, the router runs on any front-end box.
+See docs/routing.md.
+"""
+
+from .affinity import (chain_hashes, prompt_header_hashes,
+                       published_page_hashes)
+from .autoscale import AutoscaleConfig, FleetAutoscaler
+from .replica import HttpReplicaClient, ReplicaUnreachable
+from .router import Router, RouterConfig
+from .server import RouterServer
+
+__all__ = [
+    "AutoscaleConfig",
+    "FleetAutoscaler",
+    "HttpReplicaClient",
+    "ReplicaUnreachable",
+    "Router",
+    "RouterConfig",
+    "RouterServer",
+    "chain_hashes",
+    "prompt_header_hashes",
+    "published_page_hashes",
+]

@@ -132,7 +132,7 @@ class InferenceEngine:
                  eos_id: Optional[int] = None,
                  model_axis: str = MODEL_AXIS,
                  prefix_cache: Optional[bool] = None,
-                 prefix_pages: int = 0,
+                 prefix_pages: Optional[int] = None,
                  draft: Optional[Tuple[Any, Any]] = None,
                  spec_tokens: Optional[int] = None) -> None:
         cap = capacity if capacity is not None else cfg.max_seq_len
@@ -162,7 +162,17 @@ class InferenceEngine:
         if prefix_cache is None:
             prefix_cache = os.environ.get(
                 "HVD_TPU_PREFIX_CACHE", "1") != "0"
+        # The dedicated prefix reserve defaults from the env so the
+        # RETUNE actuation path (hvd-tune's prefix_pages knob, applied
+        # via HVD_TPU_PREFIX_PAGES) reaches the next engine build
+        # without a code change at every call site.
+        if prefix_pages is None:
+            prefix_pages = int(os.environ.get(
+                "HVD_TPU_PREFIX_PAGES", "0"))
         fingerprint = json.dumps(_model_dict(cfg), sort_keys=True)
+        # Exported verbatim in /healthz: the router tier keys its
+        # prefix-affinity chain hashes off this (routing/affinity.py).
+        self.fingerprint = fingerprint
         self.cache = PagedKVCache(
             cfg.n_layers, cfg.n_heads, cfg.d_model // cfg.n_heads,
             max_slots, cap // page_size, page_size,
@@ -208,11 +218,20 @@ class InferenceEngine:
                     f"draft max_seq_len {draft_cfg.max_seq_len} must "
                     f"cover the KV capacity {cap}")
             self._draft_cfg = draft_cfg
+            # The draft store rides the shared-prefix index too
+            # (hvd-spec tail): a prompt-header hit skips the DRAFT
+            # prefill as well as the target's.  Its chain hashes are
+            # keyed by the DRAFT config's fingerprint — the two caches
+            # hold different models' KV, so their indexes must never
+            # collide on a shared token prefix.
             self.draft_cache = PagedKVCache(
                 draft_cfg.n_layers, draft_cfg.n_heads,
                 draft_cfg.d_model // draft_cfg.n_heads,
                 max_slots, cap // page_size, page_size,
                 dtype=draft_cfg.dtype, mesh=mesh, model_axis=model_axis,
+                prefix_cache=prefix_cache,
+                fingerprint=json.dumps(_model_dict(draft_cfg),
+                                       sort_keys=True),
                 ledger_category="serving.draft_kv")
             if mesh is not None and self.cache.page_sharding() is not None:
                 rep = NamedSharding(mesh, P())
@@ -246,6 +265,12 @@ class InferenceEngine:
             from ..tuning import actuation as _actuation
 
             _actuation.register_spec_engine(self)
+        # hvd-tune: every engine (speculative or not) is known to the
+        # actuation layer so the prefix_pages knob can live-retune its
+        # cache's index cap and price moves via page_global_bytes.
+        from ..tuning import actuation as _tune_actuation
+
+        _tune_actuation.register_serving_engine(self)
         self._buckets = [b for b in
                          (2 ** i for i in range(1, 31))
                          if b <= self.capacity]
@@ -305,6 +330,13 @@ class InferenceEngine:
             "kv_total_pages": self.cache.total_pages,
             "kv_reclaimable_pages": prefix["reclaimable_pages"],
             "prefix_cached_pages": prefix["cached_pages"],
+            # hvd-route: everything the router tier needs to derive
+            # this replica's affinity keys lives in one health poll —
+            # the page-hash scheme config plus the live index digests.
+            "page_size": self.cache.page_size,
+            "pages_per_slot": self.cache.pages_per_slot,
+            "fingerprint": self.fingerprint,
+            "prefix_index": self.cache.export_prefix_hashes(),
             "speculative": self._draft_params is not None,
             "spec_tokens": (self.spec_tokens
                             if self._draft_params is not None else 0),
@@ -850,8 +882,12 @@ class InferenceEngine:
         same discipline the prefill+decode ≡ non-incremental contract
         already rides).  The completed prompt's full pages publish into
         the index afterwards, so the NEXT request sharing the header
-        hits.  With a draft model, the draft prefills the full prompt
-        too (its own small forward — the draft has no prefix cache)."""
+        hits.  With a draft model, the draft's prefill rides its OWN
+        shared-prefix index the same way (hvd-spec tail): a repeated
+        header skips the draft prefill too, and the suffix-only draft
+        KV is bitwise-identical to the cold full prefill's by the same
+        M>=2 gemm discipline — the acceptance rule sees identical
+        proposals either way."""
         prompt = list(req.prompt) if prompt is None else prompt
         n = len(prompt)
         shared = self.cache.lookup_prefix(prompt)
@@ -872,20 +908,24 @@ class InferenceEngine:
         self.cache.replace_pages(kp, vp)
         self.cache.publish_prefix(slot, prompt)
         if self._draft_params is not None:
-            self.draft_cache.begin_slot(slot, n)
-            dbucket = self._bucket_for(n)
+            dshared = self.draft_cache.lookup_prefix(prompt)
+            dn_shared = len(dshared) * self.draft_cache.page_size
+            self.draft_cache.begin_slot(slot, n, prefix_pages=dshared)
+            dsuffix = prompt[dn_shared:]
+            dbucket = self._bucket_for(len(dsuffix))
             dtokens = np.zeros((1, dbucket), np.int32)
-            dtokens[0, :n] = prompt
+            dtokens[0, :len(dsuffix)] = dsuffix
             dcompiled = self._prefill_exec(dbucket, draft=True)
             with _oom.guard(f"serving/draft_prefill/{dbucket}"):
                 _, dkp, dvp = dcompiled(
                     self._draft_params, self.draft_cache.k_pages,
                     self.draft_cache.v_pages,
                     self._rep(self.draft_cache.table_row(slot)),
-                    self._rep(np.zeros((1,), np.int32)),
-                    self._rep(np.asarray([n], np.int32)),
+                    self._rep(np.asarray([dn_shared], np.int32)),
+                    self._rep(np.asarray([len(dsuffix)], np.int32)),
                     self._rep(dtokens))
             self.draft_cache.replace_pages(dkp, dvp)
+            self.draft_cache.publish_prefix(slot, prompt)
         self._prev_token[slot] = prompt[-1]
         _M_PREFILLS.inc()
         return np.asarray(last)

@@ -44,7 +44,6 @@ whose count reaches zero parks in an LRU of *reclaimable* cached pages
 
 from __future__ import annotations
 
-import hashlib
 import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -60,6 +59,7 @@ from ..analysis import lockorder as _lockorder
 from ..analysis import races as _races
 from ..core.topology import MODEL_AXIS
 from ..memory import ledger as _mem
+from ..routing.affinity import chain_hashes as _chain_hash_scheme
 
 # hvd-mem satellite: free-page headroom next to serving.batch_occupancy
 # — the ROADMAP-item-2 router tier dispatches on how much KV room a
@@ -92,6 +92,10 @@ _M_PREFIX_BYTES = _telemetry.counter(
     "serving.prefix_bytes_saved",
     "KV bytes NOT recomputed thanks to prefix-cache hits (global "
     "logical bytes of the shared pages)")
+_M_PREFIX_HITS_DRAFT = _telemetry.counter(
+    "serving.prefix_hits_draft",
+    "admissions whose speculative DRAFT prefill mapped cached prefix "
+    "pages copy-free (the target's hits stay in serving.prefix_hits)")
 
 
 @_races.race_checked
@@ -165,6 +169,12 @@ class PagedKVCache:
         self._page_tokens: Dict[bytes, List[int]] = {}
         self._refcount: Dict[int, int] = {}
         self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # Live index-size target (hvd-tune's prefix_pages retune knob):
+        # None = unbounded.  The device-side reserve is fixed at
+        # construction; this caps how many pages the INDEX may hold —
+        # shrink trims the reclaimable LRU, grow just lifts the cap
+        # (pages come from the shared pool as prompts publish).
+        self._prefix_target: Optional[int] = None  # guarded_by: _lock
         if ledger_category == "serving.kv_pages":
             _M_KV_TOTAL.set(self.total_pages)
         self._set_page_gauges_locked()
@@ -250,10 +260,18 @@ class PagedKVCache:
             self._ensure_locked(slot, n_tokens - 1)
             self._lengths[slot] = n_tokens
             if prefix_pages:
-                _M_PREFIX_HITS.inc()
-                _M_PREFIX_PAGES.inc(len(prefix_pages))
-                _M_PREFIX_BYTES.inc(
-                    len(prefix_pages) * self.page_global_bytes)
+                # Split by store: the target's hits stay on the
+                # historical serving.prefix_hits family; a DRAFT
+                # store's hits (its own ledger category) count on the
+                # draft counter so the hvd-spec satellite's win is
+                # observable separately (hvd-route retunes on the sum).
+                if self._ledger_category == "serving.kv_pages":
+                    _M_PREFIX_HITS.inc()
+                    _M_PREFIX_PAGES.inc(len(prefix_pages))
+                    _M_PREFIX_BYTES.inc(
+                        len(prefix_pages) * self.page_global_bytes)
+                else:
+                    _M_PREFIX_HITS_DRAFT.inc()
             self._set_page_gauges_locked()
 
     def ensure(self, slot: int, pos: int) -> None:
@@ -360,15 +378,13 @@ class PagedKVCache:
         """Chain hash per page boundary: ``h_j`` commits to the model
         fingerprint AND every token of pages ``0..j`` — a hit on page
         ``j`` implies the whole prefix matches, so the index needs no
-        token comparison on lookup."""
-        h = hashlib.sha256(self._fingerprint)
-        out: List[bytes] = []
-        ps = self.page_size
-        for j in range(n_pages):
-            h.update(np.asarray(tokens[j * ps:(j + 1) * ps],
-                                np.int32).tobytes())
-            out.append(h.digest())
-        return out
+        token comparison on lookup.  Delegates to the jax-free
+        ``routing.affinity`` scheme: the router tier derives these SAME
+        keys from /healthz exports for prefix-affinity dispatch, and a
+        silent divergence would zero the fleet's affinity hit rate
+        (tests/test_routing.py gates byte-identity)."""
+        return _chain_hash_scheme(self._fingerprint, tokens,
+                                  self.page_size, n_pages)
 
     def lookup_prefix(self, tokens: Sequence[int]) -> List[int]:
         """Physical pages of the longest cached page-aligned STRICT
@@ -449,6 +465,10 @@ class PagedKVCache:
                 key = hashes[j]
                 if key in self._index or page in self._page_hash:
                     continue
+                if (self._prefix_target is not None
+                        and len(self._page_hash)
+                        >= self._prefix_target):
+                    break  # retuned cap reached — stop publishing
                 self._index[key] = page
                 self._page_hash[page] = key
                 self._page_tokens[key] = [int(t)
@@ -502,7 +522,10 @@ class PagedKVCache:
                 if page == 0:
                     continue
                 key = hashes[j] if j < n_full else None
-                if key is not None and key not in self._index:
+                if (key is not None and key not in self._index
+                        and (self._prefix_target is None
+                             or len(self._page_hash)
+                             < self._prefix_target)):
                     self._index[key] = page
                     self._page_hash[page] = key
                     self._page_tokens[key] = [
@@ -530,6 +553,36 @@ class PagedKVCache:
             if not any(len(k) > len(c) and k[:len(c)] == c for k in out):
                 out.append(c)
         return out
+
+    def export_prefix_hashes(self, limit: int = 512) -> List[str]:
+        """The index keys as hex chain-hash digests, most recently
+        published last, bounded to ``limit`` (newest kept) — the
+        /healthz affinity export the router tier matches its
+        router-side header hashes against.  Hex (not token chains):
+        the router needs membership, not reconstruction, and the
+        payload stays small on a hot index."""
+        with self._lock:
+            keys = list(self._index)
+        return [k.hex() for k in keys[-int(limit):]]
+
+    def set_prefix_target(self, n_pages: Optional[int]) -> int:
+        """Retune the live index-size cap (hvd-tune's ``prefix_pages``
+        knob).  Shrinking evicts reclaimable LRU pages back to the
+        free list until the index fits (REFERENCED shared pages are
+        untouchable — the cap converges as slots release them);
+        growing just lifts the cap.  Returns the index size after the
+        trim."""
+        with self._lock:
+            self._prefix_target = None if n_pages is None \
+                else max(0, int(n_pages))
+            if self._prefix_target is not None:
+                while (len(self._page_hash) > self._prefix_target
+                       and self._lru):
+                    page, _ = self._lru.popitem(last=False)
+                    self._drop_index_locked(page)
+                    self._free.append(page)
+                self._set_page_gauges_locked()
+            return len(self._page_hash)
 
     def reclaimable_pages(self) -> int:
         """Unreferenced cached prefix pages — allocatable on demand, so

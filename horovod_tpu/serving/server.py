@@ -39,6 +39,15 @@ from .scheduler import FinishReason
 
 HEALTH_KEY = "serving"
 GENERATE_PATH = "/generate"
+# Fleet hooks for the hvd-route tier (docs/routing.md): a router (or
+# operator) drains this replica for scale-down, resumes a drained
+# export into it on boot, or reads its live prefix index to warm-seed
+# a newcomer.  All three ride the elastic serving payload helpers, so
+# an HTTP drain/resume is the same migration the in-process
+# ServingState path performs.
+DRAIN_PATH = "/drain"
+RESUME_PATH = "/resume"
+PREFIXES_PATH = "/prefixes"
 
 # finish_reason -> (HTTP status, message) for requests that did not
 # complete normally.  500: the serve loop's error recovery failed it.
@@ -92,14 +101,28 @@ class LMServer:
     ``/generate``.  When no exporter is live (``hvd.init()`` without
     ``HVD_TPU_METRICS_PORT``, or no init at all) and ``port`` is given,
     it starts one — same registry, so the endpoints are identical
-    either way."""
+    either way.
+
+    ``routes`` opts out of the process-global route registry: pass a
+    private :class:`~horovod_tpu.telemetry.exporter.RouteRegistry` and
+    the server binds its own exporter to it — the way a multi-replica
+    fleet (hvd-route: several replicas behind one Router in a single
+    process, as in chaos' ``router_replica_death``) keeps each
+    replica's ``/generate``+``/healthz`` from clobbering the others'.
+    A private registry requires ``port`` (0 for ephemeral)."""
 
     def __init__(self, engine: InferenceEngine,
                  port: Optional[int] = None,
-                 host: str = "127.0.0.1") -> None:
+                 host: str = "127.0.0.1",
+                 routes: Optional[_exporter.RouteRegistry] = None
+                 ) -> None:
         self.engine = engine
         self._port = port
         self._host = host
+        self._routes = routes
+        if routes is not None and port is None:
+            raise ValueError("a private route registry needs its own "
+                             "exporter: pass port (0 for ephemeral)")
         self._own_exporter: Optional[_exporter.MetricsExporter] = None
         self._stop = threading.Event()
         self._wake = threading.Event()
@@ -122,7 +145,8 @@ class LMServer:
             return None
 
     def start(self, warm_start_dir: Optional[str] = None) -> "LMServer":
-        routes = _exporter.routes()
+        routes = (self._routes if self._routes is not None
+                  else _exporter.routes())
         # Readiness first: a probing load balancer sees NOT_READY from
         # the instant the process answers, not a 404 window.
         routes.register_health(HEALTH_KEY, self.engine.health)
@@ -132,7 +156,19 @@ class LMServer:
         # (hvd-chaos hardening; exporter.ClientProbe).
         routes.register(GENERATE_PATH, self._handle_generate,
                         methods=("POST",), pass_client=True)
-        if self._shared_exporter() is None and self._port is not None:
+        routes.register(DRAIN_PATH, self._handle_drain,
+                        methods=("POST",))
+        routes.register(RESUME_PATH, self._handle_resume,
+                        methods=("POST",))
+        routes.register(PREFIXES_PATH, self._handle_prefixes,
+                        methods=("GET",))
+        if self._routes is not None:
+            # The shared exporter serves the GLOBAL registry; private
+            # routes always get their own front door.
+            self._own_exporter = _exporter.start_exporter(
+                _telemetry.registry(), self._port, host=self._host,
+                routes=self._routes)
+        elif self._shared_exporter() is None and self._port is not None:
             self._own_exporter = _exporter.start_exporter(
                 _telemetry.registry(), self._port, host=self._host)
         self._thread = threading.Thread(
@@ -146,8 +182,12 @@ class LMServer:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
         self.engine.stop_followers()
-        routes = _exporter.routes()
+        routes = (self._routes if self._routes is not None
+                  else _exporter.routes())
         routes.unregister(GENERATE_PATH)
+        routes.unregister(DRAIN_PATH)
+        routes.unregister(RESUME_PATH)
+        routes.unregister(PREFIXES_PATH)
         routes.unregister_health(HEALTH_KEY)
         if self._own_exporter is not None:
             self._own_exporter.close()
@@ -323,3 +363,61 @@ class LMServer:
             resp["text"] = text
         return (200, (json.dumps(resp) + "\n").encode(),
                 "application/json")
+
+    # -- fleet hooks (hvd-route) -------------------------------------------
+    def _handle_drain(self, query: str,
+                      body: bytes) -> Tuple[int, bytes, str]:
+        """Scale-down: drain the engine (in-flight handlers answer 503
+        with their partials — the router resubmits those as
+        continuations), export queued work + the prefix index for the
+        caller to donate, and flip /healthz NOT_READY so the fleet
+        stops routing here."""
+        from .. import elastic as _elastic
+
+        exported = self.engine.drain()
+        payload = _elastic.serving_export_payload(self.engine, exported)
+        self.engine.mark_unready()
+        self._wake.set()  # let the loop notice the emptied scheduler
+        return (200, (json.dumps(payload) + "\n").encode(),
+                "application/json")
+
+    def _handle_resume(self, query: str,
+                       body: bytes) -> Tuple[int, bytes, str]:
+        """Boot/scale-up: install a drained export (requests resubmit,
+        prefix chains ghost-seed the cache) and reopen admission.  A
+        replica that was drained NOT_READY warm-starts back to ready —
+        executables come from the compile cache, so this is cheap on a
+        relaunch."""
+        from .. import elastic as _elastic
+
+        try:
+            payload = json.loads(body.decode() or "{}")
+        except ValueError:
+            return (400, b'{"error": "invalid JSON"}\n',
+                    "application/json")
+        if not self.engine.ready:
+            self.engine.warm_start()
+        if isinstance(payload, dict) and not payload.get("requests"):
+            # Prefix-only donation (an autoscaler warming this replica
+            # from a peer's index): ghost-seed WITHOUT the wholesale
+            # drain-and-replace — a live replica's in-flight work
+            # survives the gift.
+            if payload.get("prefixes"):
+                self.engine.seed_prefixes(payload["prefixes"])
+            installed = []
+        else:
+            installed = _elastic.serving_install_payload(self.engine,
+                                                         payload)
+        self._wake.set()
+        return (200, (json.dumps(
+            {"installed": len(installed),
+             "ready": self.engine.ready}) + "\n").encode(),
+            "application/json")
+
+    def _handle_prefixes(self, query: str,
+                         body: bytes) -> Tuple[int, bytes, str]:
+        """The live prefix index as maximal token chains — the
+        autoscaler's boot-seed source (no drain required)."""
+        return (200, (json.dumps(
+            {"prefixes": self.engine.export_prefix_index()})
+            + "\n").encode(), "application/json")

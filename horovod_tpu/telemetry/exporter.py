@@ -216,9 +216,15 @@ class MetricsExporter:
     the process-global route registry."""
 
     def __init__(self, registry: MetricsRegistry, port: int,
-                 host: str = "0.0.0.0") -> None:
+                 host: str = "0.0.0.0",
+                 routes: Optional[RouteRegistry] = None) -> None:
         self.registry = registry
-        self.routes = _routes
+        # Default is the process-global registry (subsystems register
+        # into it without holding an exporter reference); a private
+        # RouteRegistry lets a second tier — hvd-route's front door —
+        # serve its own /generate in the same process without fighting
+        # a colocated replica over the path.
+        self.routes = _routes if routes is None else routes
         exporter = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -307,5 +313,7 @@ class MetricsExporter:
 
 
 def start_exporter(registry: MetricsRegistry, port: int,
-                   host: str = "0.0.0.0") -> MetricsExporter:
-    return MetricsExporter(registry, port, host=host)
+                   host: str = "0.0.0.0",
+                   routes: Optional[RouteRegistry] = None
+                   ) -> MetricsExporter:
+    return MetricsExporter(registry, port, host=host, routes=routes)

@@ -41,6 +41,10 @@ _M_APPLIED = _telemetry.counter(
 # engines at construction).
 _inflight_windows: "weakref.WeakSet" = weakref.WeakSet()
 _spec_engines: "weakref.WeakSet" = weakref.WeakSet()
+# EVERY serving engine (speculative or not) registers here so the
+# prefix_pages knob can live-trim its cache's index cap and advertise
+# the per-page byte price the planner veto needs.
+_serving_engines: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def register_inflight_window(window) -> None:
@@ -53,6 +57,14 @@ def register_spec_engine(engine) -> None:
 
 def spec_engines() -> List[object]:
     return list(_spec_engines)
+
+
+def register_serving_engine(engine) -> None:
+    _serving_engines.add(engine)
+
+
+def serving_engines() -> List[object]:
+    return list(_serving_engines)
 
 
 # ---------------------------------------------------------------------------
@@ -69,6 +81,11 @@ def _parse_value(knob: str, raw: str):
         v = float(raw)
         if v <= 0:
             raise ValueError(f"cycle_time must be > 0, got {v}")
+        return v
+    if knob == _policy.KNOB_PREFIX_PAGES:
+        v = int(float(raw))
+        if v < 0:  # 0 is legal: the shrink rule may retire the reserve
+            raise ValueError(f"prefix_pages must be >= 0, got {v}")
         return v
     v = int(float(raw))  # autotune sweeps may format ints as floats
     if v < 1:
@@ -91,13 +108,31 @@ def current_knobs(st) -> Dict[str, object]:
         spec = int(os.environ.get("HVD_TPU_SPEC_TOKENS", "3"))
     except ValueError:
         spec = 3
+    try:
+        prefix = max(0, int(os.environ.get("HVD_TPU_PREFIX_PAGES",
+                                           "0")))
+    except ValueError:
+        prefix = 0
     knobs: Dict[str, object] = {
         _policy.KNOB_DCN_COMPRESS: dcn,
         _policy.KNOB_MAX_INFLIGHT: inflight,
         _policy.KNOB_FUSION_THRESHOLD: int(st.fusion_threshold_bytes),
         _policy.KNOB_CYCLE_TIME: float(st.tick_seconds),
         _policy.KNOB_SPEC_TOKENS: spec,
+        _policy.KNOB_PREFIX_PAGES: prefix,
     }
+    # A live serving engine advertises its per-page KV byte cost so
+    # the planner can price prefix_pages moves (memory/planner.py
+    # retune_delta_bytes).
+    for engine in serving_engines():
+        cache = getattr(engine, "cache", None)
+        per_page = getattr(cache, "page_global_bytes", None)
+        if per_page is not None:
+            try:
+                knobs["prefix_page_bytes"] = int(per_page)
+            except (TypeError, ValueError):
+                pass
+            break
     # A live speculative engine advertises its per-token verify cost so
     # the planner can price spec_tokens moves (memory/planner.py).
     for engine in spec_engines():
@@ -165,12 +200,26 @@ def _apply_spec_tokens(st, value: int) -> None:
             pass           # wedge the drain tick
 
 
+def _apply_prefix_pages(st, value: int) -> None:
+    # The env feeds the NEXT engine build (the device-side reserve is
+    # fixed at construction); live engines get their index cap
+    # retuned immediately — shrink trims the reclaimable LRU, grow
+    # lifts the cap so subsequent prompts publish into it.
+    os.environ["HVD_TPU_PREFIX_PAGES"] = str(value)
+    for engine in list(_serving_engines):
+        try:
+            engine.cache.set_prefix_target(int(value))
+        except Exception:  # noqa: BLE001 — a draining engine must not
+            pass           # wedge the drain tick
+
+
 _APPLIERS = {
     _policy.KNOB_DCN_COMPRESS: _apply_dcn_compress,
     _policy.KNOB_MAX_INFLIGHT: _apply_max_inflight,
     _policy.KNOB_FUSION_THRESHOLD: _apply_fusion_threshold,
     _policy.KNOB_CYCLE_TIME: _apply_cycle_time,
     _policy.KNOB_SPEC_TOKENS: _apply_spec_tokens,
+    _policy.KNOB_PREFIX_PAGES: _apply_prefix_pages,
 }
 
 
@@ -261,6 +310,9 @@ def _collect_tuning(reg) -> None:
     reg.gauge("tuning.knob.spec_tokens",
               "speculative decode depth").set(
         knobs[_policy.KNOB_SPEC_TOKENS])
+    reg.gauge("tuning.knob.prefix_pages",
+              "dedicated shared-prefix page reserve").set(
+        knobs[_policy.KNOB_PREFIX_PAGES])
 
 
 def install_collector() -> None:

@@ -41,9 +41,10 @@ KNOB_MAX_INFLIGHT = "max_inflight"
 KNOB_FUSION_THRESHOLD = "fusion_threshold"
 KNOB_CYCLE_TIME = "cycle_time"
 KNOB_SPEC_TOKENS = "spec_tokens"
+KNOB_PREFIX_PAGES = "prefix_pages"
 
 KNOB_NAMES = (KNOB_DCN_COMPRESS, KNOB_MAX_INFLIGHT, KNOB_FUSION_THRESHOLD,
-              KNOB_CYCLE_TIME, KNOB_SPEC_TOKENS)
+              KNOB_CYCLE_TIME, KNOB_SPEC_TOKENS, KNOB_PREFIX_PAGES)
 
 
 @dataclass(frozen=True)
@@ -56,7 +57,11 @@ class WindowSnapshot:
     no speculative engine is live); ``headroom_frac`` is free/capacity
     HBM (-1 unknown); ``headroom_bytes`` the absolute free bytes (-1
     unknown) the planner veto prices against; ``knobs`` the CURRENT
-    knob values the deltas start from."""
+    knob values the deltas start from.  ``prefix_hit_rate`` is the
+    window's shared-prefix hit fraction (target + draft hits over
+    prefills, -1 when no serving engine is live) and ``kv_free_frac``
+    the KV admission-headroom fraction (free/total pages, -1 unknown)
+    — the prefix-reserve retune rule's inputs."""
 
     index: int
     legs: Mapping[str, float]
@@ -65,6 +70,8 @@ class WindowSnapshot:
     spec_acceptance: float = -1.0
     headroom_frac: float = -1.0
     headroom_bytes: int = -1
+    prefix_hit_rate: float = -1.0
+    kv_free_frac: float = -1.0
 
 
 @dataclass(frozen=True)
@@ -92,6 +99,13 @@ class PolicyConfig:
     straggler_skew_us: float = 1000.0  # sensors' persistence threshold
     max_inflight_cap: int = 8
     fusion_floor_bytes: int = 1 << 20
+    # Prefix-reserve retuning (hvd-route tail): a hot index starving
+    # for KV headroom earns a bigger dedicated reserve; a cold index
+    # gives its reserve back.
+    prefix_hit_high: float = 0.5   # hit rate worth growing for
+    prefix_hit_low: float = 0.05   # hit rate the reserve shrinks under
+    prefix_kv_floor: float = 0.25  # kv_free_frac that signals pressure
+    prefix_pages_cap: int = 256
     pinned: frozenset = field(default_factory=frozenset)
 
 
@@ -173,6 +187,25 @@ class PolicyEngine:
                     f"buffers {cur} -> {nxt}")
         return self._propose_dcn(snap)
 
+    def _propose_prefix_grow(self, snap: WindowSnapshot):
+        cur = int(snap.knobs.get(KNOB_PREFIX_PAGES, 0) or 0)
+        if cur >= self.cfg.prefix_pages_cap:
+            return None
+        nxt = min(self.cfg.prefix_pages_cap, max(cur * 2, 8))
+        return (KNOB_PREFIX_PAGES, nxt,
+                f"prefix hit rate {snap.prefix_hit_rate:.0%} with KV "
+                f"headroom at {snap.kv_free_frac:.0%}: grow the prefix "
+                f"reserve {cur} -> {nxt} pages")
+
+    def _propose_prefix_shrink(self, snap: WindowSnapshot):
+        cur = int(snap.knobs.get(KNOB_PREFIX_PAGES, 0) or 0)
+        if cur <= 0:
+            return None
+        return (KNOB_PREFIX_PAGES, cur // 2,
+                f"prefix hit rate {snap.prefix_hit_rate:.0%} below "
+                f"{self.cfg.prefix_hit_low:.0%}: shrink the prefix "
+                f"reserve {cur} -> {cur // 2} pages")
+
     # -- the window step ---------------------------------------------------
     def _conditions(self, snap: WindowSnapshot) -> List[Tuple[str, float]]:
         """(rule, urgency) for every rule whose condition holds this
@@ -193,6 +226,17 @@ class PolicyEngine:
             held.append(("straggler", 0.5))
         if 0.0 <= snap.spec_acceptance < cfg.low_acceptance:
             held.append(("spec", 0.4))
+        # Prefix-reserve balance: a HOT index under KV-headroom
+        # pressure earns dedicated pages (the shared pool is thrashing
+        # cached prefixes against live slots); a COLD index with a
+        # reserve gives it back.  Mutually exclusive by construction
+        # (hit rate cannot be both >= high and < low).
+        if (0.0 <= snap.kv_free_frac < cfg.prefix_kv_floor
+                and snap.prefix_hit_rate >= cfg.prefix_hit_high):
+            held.append(("prefix_grow", 0.3))
+        if (0.0 <= snap.prefix_hit_rate < cfg.prefix_hit_low
+                and int(snap.knobs.get(KNOB_PREFIX_PAGES, 0) or 0) > 0):
+            held.append(("prefix_shrink", 0.2))
         held.sort(key=lambda e: (-e[1], e[0]))
         return held
 
@@ -202,6 +246,8 @@ class PolicyEngine:
         "straggler": _propose_rebucket,
         "spec": _propose_spec,
         "headroom": _propose_headroom,
+        "prefix_grow": _propose_prefix_grow,
+        "prefix_shrink": _propose_prefix_shrink,
     }
 
     def step(self, snap: WindowSnapshot) -> Optional[Decision]:

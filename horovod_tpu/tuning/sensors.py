@@ -28,6 +28,7 @@ class WindowAggregator:
         self._st = st
         self._straggler_skew_s = float(straggler_skew_s)
         self._prev_legs: Optional[Dict[str, float]] = None
+        self._prev_prefix: Optional[Dict[str, float]] = None
         self._index = 0
 
     def _window_legs(self) -> Dict[str, float]:
@@ -88,10 +89,47 @@ class WindowAggregator:
             return free / float(capacity), free
         return -1.0, -1
 
+    def _prefix(self):
+        """(hit_rate, kv_free_frac) for the prefix-reserve rule, from
+        the live metrics registry: windowed differencing of the
+        serving.prefix_hits (+ draft) and serving.prefills counters
+        (monotone-safe like the legs), plus the current
+        kv_free_pages/kv_total_pages gauges; (-1.0, -1.0) when no
+        serving engine publishes them."""
+        from .. import telemetry as _telemetry
+
+        snap = _telemetry.registry().snapshot()
+
+        def _val(name: str):
+            m = snap.get(name)
+            return None if m is None else float(m.get("value", 0))
+
+        prefills = _val("serving.prefills")
+        if prefills is None:
+            self._prev_prefix = None
+            return -1.0, -1.0
+        hits = ((_val("serving.prefix_hits") or 0.0)
+                + (_val("serving.prefix_hits_draft") or 0.0))
+        totals = {"hits": hits, "prefills": prefills}
+        prev = self._prev_prefix
+        self._prev_prefix = dict(totals)
+        if prev is not None and all(totals[k] >= prev.get(k, 0.0)
+                                    for k in totals):
+            hits = totals["hits"] - prev.get("hits", 0.0)
+            prefills = totals["prefills"] - prev.get("prefills", 0.0)
+        rate = hits / prefills if prefills > 0 else -1.0
+        total_pages = _val("serving.kv_total_pages") or 0.0
+        free_pages = _val("serving.kv_free_pages")
+        kv_free = (free_pages / total_pages
+                   if free_pages is not None and total_pages > 0
+                   else -1.0)
+        return rate, kv_free
+
     def sample(self) -> WindowSnapshot:
         from . import actuation as _actuation
 
         frac, free = self._headroom()
+        hit_rate, kv_free = self._prefix()
         snap = WindowSnapshot(
             index=self._index,
             legs=self._window_legs(),
@@ -100,6 +138,8 @@ class WindowAggregator:
             spec_acceptance=self._spec_acceptance(),
             headroom_frac=frac,
             headroom_bytes=free,
+            prefix_hit_rate=hit_rate,
+            kv_free_frac=kv_free,
         )
         self._index += 1
         return snap

@@ -91,6 +91,37 @@ def test_transformer_lm_export_then_serve_lm_example(tmp_path):
 
 
 @pytest.mark.slow
+def test_distill_draft_then_serve_with_draft_example(tmp_path):
+    """Train → --export → distill a draft → serve with speculative
+    decoding armed (--draft), end to end through the checkpoint pair
+    (hvd-spec + hvd-serve, docs/inference.md)."""
+    ckpt = str(tmp_path / "lm-ckpt")
+    draft = str(tmp_path / "lm-draft")
+    out = _run_example("transformer_lm.py",
+                       {"HVD_TPU_EXAMPLE_STEPS": "5"},
+                       args=("--export", ckpt))
+    assert "serving checkpoint exported" in out
+    out = _run_example("distill_draft.py",
+                       {"HVD_TPU_EXAMPLE_STEPS": "8"},
+                       args=(ckpt, "--export", draft))
+    assert "draft checkpoint exported" in out
+    assert "distill_draft: OK" in out
+    assert os.path.exists(os.path.join(draft, "params.msgpack"))
+    assert os.path.exists(os.path.join(draft, "serving.json"))
+    out = _run_example("serve_lm.py",
+                       args=(ckpt, "--draft", draft,
+                             "--tokens", "5,3,8,1", "-n", "8"))
+    assert "serve_lm: OK" in out
+    line = [ln for ln in out.splitlines()
+            if ln.strip().startswith("{")][0]
+    import json
+
+    resp = json.loads(line)
+    assert len(resp["tokens"]) == 8
+    assert all(0 <= t < 512 for t in resp["tokens"])
+
+
+@pytest.mark.slow
 def test_resnet50_synthetic_example():
     # Start cold: the example resumes from its fixed checkpoint path.
     ckpt = "/tmp/horovod_tpu_resnet50/ckpt.msgpack"

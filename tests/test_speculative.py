@@ -273,6 +273,29 @@ def test_spec_composes_with_prefix_cache():
                         max_new_tokens=6) == b_ref
 
 
+def test_spec_draft_store_takes_prefix_hits_of_its_own():
+    """hvd-route satellite: the DRAFT KV store rides the shared-prefix
+    index too — a repeated header maps copy-free on BOTH stores, and
+    the draft's hits count on the split ``serving.prefix_hits_draft``
+    counter (hvd-tune's hit-rate sensor sums the two)."""
+    from horovod_tpu import telemetry as _telemetry
+
+    def draft_hits():
+        return _telemetry.metrics().get(
+            "serving.prefix_hits_draft", {}).get("value", 0)
+
+    header = list(range(40, 56))  # two full pages at page_size=8
+    eng = spec_eng()
+    eng.generate(header + [60, 61], max_new_tokens=4)
+    # The first request published the header pages on both stores.
+    assert len(eng.draft_cache.lookup_prefix(header + [70])) == 2
+    assert eng.draft_cache.prefix_stats()["cached_pages"] >= 2
+    h0 = draft_hits()
+    ref = reference_rollout(header + [70, 71], 4, 32)
+    assert eng.generate(header + [70, 71], max_new_tokens=4) == ref
+    assert draft_hits() - h0 == 1
+
+
 @pytest.mark.slow
 def test_spec_warm_start_records_and_rebuilds_executables(tmp_path,
                                                           monkeypatch):

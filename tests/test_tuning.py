@@ -26,6 +26,7 @@ from horovod_tpu.tuning.policy import (COMPRESSION_LADDER,
                                        KNOB_DCN_COMPRESS,
                                        KNOB_FUSION_THRESHOLD,
                                        KNOB_MAX_INFLIGHT,
+                                       KNOB_PREFIX_PAGES,
                                        KNOB_SPEC_TOKENS, PolicyConfig,
                                        PolicyEngine, WindowSnapshot)
 
@@ -152,6 +153,61 @@ def test_low_acceptance_shrinks_spec_tokens_to_floor():
             values.append(d.value)
             knobs[KNOB_SPEC_TOKENS] = d.value
     assert values == [2, 1]  # 3 -> 2 -> 1, then the floor holds
+
+
+def test_prefix_reserve_grows_under_kv_pressure_with_hot_index():
+    """hvd-route satellite: a HOT shared-prefix index (hit rate >= high)
+    while KV admission headroom thrashes (kv_free_frac < floor) earns a
+    dedicated page reserve, doubling up to the cap."""
+    eng = PolicyEngine(PolicyConfig(sustain=1, cooldown=0))
+    knobs = dict(DEFAULT_KNOBS)
+    values = []
+    for i in range(8):
+        d = eng.step(snap(i, kv_free_frac=0.1, prefix_hit_rate=0.7,
+                          knobs=knobs))
+        if d is not None:
+            assert d.knob == KNOB_PREFIX_PAGES
+            values.append(d.value)
+            knobs[KNOB_PREFIX_PAGES] = d.value
+    assert values == [8, 16, 32, 64, 128, 256]  # then the cap holds
+    assert "grow the prefix reserve" in eng.decisions[0].reason
+
+
+def test_prefix_reserve_shrinks_when_index_goes_cold():
+    eng = PolicyEngine(PolicyConfig(sustain=1, cooldown=0))
+    knobs = dict(DEFAULT_KNOBS)
+    knobs[KNOB_PREFIX_PAGES] = 32
+    d = eng.step(snap(0, prefix_hit_rate=0.01, knobs=knobs))
+    assert d is not None
+    assert d.knob == KNOB_PREFIX_PAGES
+    assert d.value == 16
+    assert "shrink the prefix reserve" in d.reason
+
+
+def test_prefix_rules_idle_in_dead_band_and_without_signal():
+    eng = PolicyEngine(PolicyConfig(sustain=1, cooldown=0))
+    # Dead band: hit rate between low and high never moves the knob.
+    assert eng.step(snap(0, kv_free_frac=0.1,
+                         prefix_hit_rate=0.3)) is None
+    # Hot index but ample KV headroom: no pressure, no reserve.
+    assert eng.step(snap(1, kv_free_frac=0.9,
+                         prefix_hit_rate=0.9)) is None
+    # Cold index with no reserve: nothing to give back.
+    assert eng.step(snap(2, prefix_hit_rate=0.0)) is None
+    # Unknown sensors (the -1.0 defaults) hold everything still.
+    assert eng.step(snap(3)) is None
+    assert eng.decisions == []
+
+
+def test_prefix_grow_is_planner_priced():
+    """The reserve's byte delta rides the same priced veto as every
+    other knob — a grow the host cannot afford is refused."""
+    eng = PolicyEngine(PolicyConfig(sustain=1, cooldown=3),
+                       price=lambda knob, old, new, s: 10 << 30)
+    assert eng.step(snap(0, kv_free_frac=0.1, prefix_hit_rate=0.7,
+                         headroom_bytes=1 << 20)) is None
+    assert eng.vetoes == 1
+    assert eng.veto_log[0][1] == KNOB_PREFIX_PAGES
 
 
 def test_headroom_pressure_outranks_speed_rules():
